@@ -1,0 +1,26 @@
+"""reporter_tpu — a TPU-native GPS probe map-matching and traffic-reporting framework.
+
+A ground-up rebuild of the capabilities of Open Traffic Reporter
+(reference: /root/reference, cuulee/reporter): ingest raw GPS probe data,
+map-match traces to OSMLR traffic segments with an HMM matcher, convert matched
+segments into speed reports, anonymise them behind a privacy threshold, and
+flush time-quantised geographic tiles to a datastore.
+
+Where the reference runs a one-trace-at-a-time C++ Meili matcher behind an HTTP
+service (reference: py/reporter_service.py), this framework runs a *batched*
+JAX/XLA HMM: host-side candidate lookup feeds fixed-width tensors to a vmapped
+Viterbi decode on TPU, thousands of padded traces per device step.
+
+Layout:
+  core/      — value types, OSMLR id math, tile hierarchy, geodesy
+  graph/     — road network, spatial index, candidate extraction (host side)
+  matcher/   — JAX HMM (emission/transition/Viterbi), segment assembly, Match API
+  service/   — /report HTTP service with micro-batching, report() semantics
+  streaming/ — formatter, per-uuid batcher, anonymiser, broker adapters
+  pipeline/  — batched (historical) 3-stage pipeline
+  parallel/  — device mesh + sharding of the batched matcher
+  ops/       — Pallas TPU kernels for the hot ops
+  native/    — C++ host runtime (spatial index, route distances) via ctypes
+"""
+
+__version__ = "0.1.0"

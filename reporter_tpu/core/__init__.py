@@ -1,0 +1,28 @@
+from .osmlr import (
+    LEVEL_BITS,
+    TILE_INDEX_BITS,
+    SEGMENT_INDEX_BITS,
+    LEVEL_MASK,
+    TILE_INDEX_MASK,
+    SEGMENT_INDEX_MASK,
+    INVALID_SEGMENT_ID,
+    make_segment_id,
+    tile_level,
+    tile_index,
+    segment_index,
+    tile_id_of_segment,
+)
+from .geo import equirectangular_m, METERS_PER_DEG
+from .types import Point, Segment, TimeQuantisedTile
+from .tiles import TileHierarchy, Tiles, BoundingBox, tiles_for_bbox
+
+__all__ = [
+    "LEVEL_BITS", "TILE_INDEX_BITS", "SEGMENT_INDEX_BITS",
+    "LEVEL_MASK", "TILE_INDEX_MASK", "SEGMENT_INDEX_MASK",
+    "INVALID_SEGMENT_ID",
+    "make_segment_id", "tile_level", "tile_index", "segment_index",
+    "tile_id_of_segment",
+    "equirectangular_m", "METERS_PER_DEG",
+    "Point", "Segment", "TimeQuantisedTile",
+    "TileHierarchy", "Tiles", "BoundingBox", "tiles_for_bbox",
+]

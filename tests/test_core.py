@@ -1,0 +1,158 @@
+import math
+
+import pytest
+
+from reporter_tpu.core import (
+    INVALID_SEGMENT_ID,
+    BoundingBox,
+    Point,
+    Segment,
+    TileHierarchy,
+    TimeQuantisedTile,
+    equirectangular_m,
+    make_segment_id,
+    segment_index,
+    tile_id_of_segment,
+    tile_index,
+    tile_level,
+    tiles_for_bbox,
+)
+
+
+class TestOsmlr:
+    def test_invalid_sentinel(self):
+        # reference: Segment.java:16
+        assert INVALID_SEGMENT_ID == 0x3FFFFFFFFFFF
+
+    def test_roundtrip(self):
+        sid = make_segment_id(2, 12345, 678)
+        assert tile_level(sid) == 2
+        assert tile_index(sid) == 12345
+        assert segment_index(sid) == 678
+
+    def test_tile_id_masks_off_segment_index(self):
+        sid = make_segment_id(1, 99, 1000)
+        assert tile_id_of_segment(sid) == make_segment_id(1, 99, 0)
+        assert tile_id_of_segment(sid) == (sid & 0x1FFFFFF)
+
+    def test_ranges_checked(self):
+        with pytest.raises(ValueError):
+            make_segment_id(8, 0, 0)
+        with pytest.raises(ValueError):
+            make_segment_id(0, 1 << 22, 0)
+        with pytest.raises(ValueError):
+            make_segment_id(0, 0, 1 << 21)
+
+
+class TestGeo:
+    def test_equirectangular_equator_lon_degree(self):
+        # one degree of longitude at the equator ~ 111.3 km
+        d = equirectangular_m(0.0, 0.0, 0.0, 1.0)
+        assert abs(d - 20037581.187 / 180.0) < 1.0
+
+    def test_symmetric(self):
+        a = equirectangular_m(14.6, 121.0, 14.61, 121.01)
+        b = equirectangular_m(14.61, 121.01, 14.6, 121.0)
+        assert a == pytest.approx(b)
+
+
+class TestPoint:
+    def test_binary_roundtrip(self):
+        p = Point(14.5995, 120.9842, 50, 1700000000)
+        raw = p.to_bytes()
+        assert len(raw) == Point.SIZE == 20
+        q = Point.from_bytes(raw)
+        assert q.accuracy == 50 and q.time == 1700000000
+        assert q.lat == pytest.approx(14.5995, abs=1e-4)
+
+    def test_json_str(self):
+        p = Point(1.5, -2.25, 10, 123)
+        assert p.to_json_str() == '{"lat":1.5,"lon":-2.25,"time":123,"accuracy":10}'
+
+
+class TestSegment:
+    def test_valid(self):
+        s = Segment(5, 6, 10.0, 20.0, 100, 0)
+        assert s.valid()
+        assert not Segment(5, 6, 0.0, 20.0, 100, 0).valid()
+        assert not Segment(5, 6, 10.0, 10.0, 100, 0).valid()
+        assert not Segment(5, 6, 10.0, 20.0, 0, 0).valid()
+        assert not Segment(5, 6, 10.0, 20.0, 100, -1).valid()
+
+    def test_none_next_becomes_invalid(self):
+        s = Segment(5, None, 10.0, 20.0, 100, 0)
+        assert s.next_id == INVALID_SEGMENT_ID
+
+    def test_csv_row(self):
+        s = Segment(42, None, 10.4, 19.6, 100, 3)
+        row = s.csv_row("AUTO", "src")
+        # duration=round(9.2)=9, min floor=10, max ceil=20, empty next_id
+        assert row == "42,,9,1,100,3,10,20,src,AUTO"
+
+    def test_binary_roundtrip(self):
+        s = Segment(make_segment_id(0, 7, 9), make_segment_id(0, 7, 10),
+                    1.5, 9.5, 250, 12)
+        raw = s.to_bytes()
+        assert len(raw) == Segment.SIZE == 40
+        t = Segment.from_bytes(raw)
+        assert t == s
+
+
+class TestTimeQuantisedTile:
+    def test_span_buckets(self):
+        # a segment from t=3599 to t=7201 with 3600s quantisation touches 3 buckets
+        # (reference: TimeQuantisedTile.java:26-35)
+        s = Segment(make_segment_id(0, 7, 9), None, 3599.0, 7201.0, 100, 0)
+        tiles = TimeQuantisedTile.tiles_for(s, 3600)
+        assert [t.time_range_start for t in tiles] == [0, 3600, 7200]
+        assert all(t.tile_id == s.tile_id() for t in tiles)
+
+    def test_level_index_extraction(self):
+        s = Segment(make_segment_id(1, 500, 3), None, 10.0, 20.0, 100, 0)
+        (tile,) = TimeQuantisedTile.tiles_for(s, 3600)
+        assert tile.tile_level() == 1
+        assert tile.tile_index() == 500
+
+    def test_binary_roundtrip(self):
+        t = TimeQuantisedTile(7200, 0x1ABCDE)
+        assert TimeQuantisedTile.from_bytes(t.to_bytes()) == t
+
+
+class TestTiles:
+    def test_hierarchy_shapes(self):
+        h = TileHierarchy()
+        assert h.tiles(2).ncolumns == 1440 and h.tiles(2).nrows == 720
+        assert h.tiles(1).ncolumns == 360 and h.tiles(1).nrows == 180
+        assert h.tiles(0).ncolumns == 90 and h.tiles(0).nrows == 45
+
+    def test_row_col_edges(self):
+        t = TileHierarchy().tiles(0)
+        assert t.row(-91) == -1 and t.col(-181) == -1
+        assert t.row(90.0) == t.nrows - 1
+        assert t.col(180.0) == t.ncolumns - 1
+
+    def test_file_path_level2(self):
+        t = TileHierarchy().tiles(2)
+        # max_tile_id=1036799 (7 digits -> padded to 9)
+        assert t.file_path(756425, 2, "gph") == "2/000/756/425.gph"
+
+    def test_file_path_level0_leading_zero(self):
+        t = TileHierarchy().tiles(0)
+        # max_tile_id=4049 (4 digits -> padded to 6)
+        assert t.file_path(2415, 0, "gph") == "0/002/415.gph"
+
+    def test_manila_bbox_contains_known_tile(self):
+        # Manila ~ (14.6, 121.0)
+        paths = list(tiles_for_bbox([120.9, 14.5, 121.1, 14.7], "gph"))
+        t2 = TileHierarchy().tiles(2)
+        expected = t2.file_path(t2.tile_id(14.6, 121.0), 2, "gph")
+        assert expected in paths
+
+    def test_antimeridian_split(self):
+        paths = list(tiles_for_bbox([179.5, -1.0, -179.5, 1.0], "gph", levels=(0,)))
+        assert len(paths) > 0
+        # tiles from both sides of the antimeridian appear
+        t0 = TileHierarchy().tiles(0)
+        west = t0.file_path(t0.tile_id(0.0, 179.9), 0, "gph")
+        east = t0.file_path(t0.tile_id(0.0, -179.9), 0, "gph")
+        assert west in paths and east in paths
