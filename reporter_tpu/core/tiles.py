@@ -117,6 +117,8 @@ def tiles_for_bbox(bbox_lonlat: List[float], suffix: str = "gph",
             t = hierarchy.tiles(level)
             min_col, max_col = t.col(box.minx), t.col(box.maxx)
             min_row, max_row = t.row(box.miny), t.row(box.maxy)
+            if -1 in (min_col, max_col, min_row, max_row):
+                raise ValueError(f"bbox {bbox_lonlat} outside tile system")
             for r in range(min_row, max_row + 1):
                 for c in range(min_col, max_col + 1):
                     yield t.file_path(r * t.ncolumns + c, level, suffix)

@@ -1,0 +1,9 @@
+from .network import RoadNetwork, EdgeAttr
+from .spatial import SpatialGrid, CandidateSet
+from .route import route_distance, candidate_route_matrices
+
+__all__ = [
+    "RoadNetwork", "EdgeAttr",
+    "SpatialGrid", "CandidateSet",
+    "route_distance", "candidate_route_matrices",
+]

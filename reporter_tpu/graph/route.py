@@ -1,0 +1,162 @@
+"""Bounded shortest-path route distances for HMM transition costs.
+
+Meili's transition probability compares the network route distance between
+consecutive candidate pairs against the great-circle distance between the
+probes (reference: SURVEY.md §2.3; knobs ``max-route-distance-factor`` and
+``beta`` at Dockerfile:14-17). Graph search is inherently sequential, so it
+stays on the host: a bounded Dijkstra over the CSR adjacency, with a
+per-source-node cache so a batch of traces over the same city amortises the
+searches. The device only ever sees the resulting (T-1, K, K) cost tensors.
+
+UNREACHABLE marks pairs with no route within the bound; the device matcher
+turns those into -inf transition scores.
+"""
+from __future__ import annotations
+
+import heapq
+from typing import Dict, Optional
+
+import numpy as np
+
+from .network import RoadNetwork
+from .spatial import CandidateSet, PAD_EDGE
+
+UNREACHABLE = np.float32(1.0e9)
+
+
+def _dijkstra_bounded(net: RoadNetwork, source_node: int, max_dist: float,
+                      ) -> Dict[int, float]:
+    """Single-source shortest path lengths (meters) out to ``max_dist``."""
+    offsets, edge_ids = net.csr()
+    lengths = net.edge_length_m
+    ends = net.edge_end
+    dist = {source_node: 0.0}
+    heap = [(0.0, source_node)]
+    while heap:
+        d, u = heapq.heappop(heap)
+        if d > dist.get(u, np.inf):
+            continue
+        if d > max_dist:
+            break
+        for idx in range(offsets[u], offsets[u + 1]):
+            e = edge_ids[idx]
+            v = int(ends[e])
+            nd = d + float(lengths[e])
+            if nd <= max_dist and nd < dist.get(v, np.inf):
+                dist[v] = nd
+                heapq.heappush(heap, (nd, v))
+    return dist
+
+
+def shortest_path_edges(net: RoadNetwork, src_node: int, dst_node: int,
+                        max_dist: float = 1.0e8):
+    """Edge-id path from ``src_node`` to ``dst_node`` (Dijkstra with
+    predecessor tracking), or None if unreachable. Used by the synthetic
+    trace generator, not the matcher hot path."""
+    offsets, edge_ids = net.csr()
+    lengths = net.edge_length_m
+    ends = net.edge_end
+    dist = {src_node: 0.0}
+    pred: Dict[int, int] = {}  # node -> incoming edge id
+    heap = [(0.0, src_node)]
+    while heap:
+        d, u = heapq.heappop(heap)
+        if u == dst_node:
+            break
+        if d > dist.get(u, np.inf) or d > max_dist:
+            continue
+        for idx in range(offsets[u], offsets[u + 1]):
+            e = int(edge_ids[idx])
+            v = int(ends[e])
+            nd = d + float(lengths[e])
+            if nd <= max_dist and nd < dist.get(v, np.inf):
+                dist[v] = nd
+                pred[v] = e
+                heapq.heappush(heap, (nd, v))
+    if dst_node not in dist or (dst_node != src_node and dst_node not in pred):
+        return None
+    path = []
+    node = dst_node
+    while node != src_node:
+        e = pred[node]
+        path.append(e)
+        node = int(net.edge_start[e])
+    return path[::-1]
+
+
+class RouteCache:
+    """Caches bounded single-source Dijkstra results by (source node).
+
+    A cached entry is only reused when its bound covers the requested bound;
+    otherwise it is recomputed at the larger bound.
+    """
+
+    def __init__(self, net: RoadNetwork):
+        self.net = net
+        self._cache: Dict[int, tuple] = {}  # node -> (bound, dist dict)
+        self.hits = 0
+        self.misses = 0
+
+    def distances_from(self, node: int, max_dist: float) -> Dict[int, float]:
+        entry = self._cache.get(node)
+        if entry is not None and entry[0] >= max_dist:
+            self.hits += 1
+            return entry[1]
+        self.misses += 1
+        dist = _dijkstra_bounded(self.net, node, max_dist)
+        self._cache[node] = (max_dist, dist)
+        return dist
+
+
+def route_distance(net: RoadNetwork, edge_a: int, offset_a: float,
+                   edge_b: int, offset_b: float, max_dist: float,
+                   cache: Optional[RouteCache] = None) -> float:
+    """Network distance from a point ``offset_a`` along ``edge_a`` to a point
+    ``offset_b`` along ``edge_b``; UNREACHABLE beyond ``max_dist``."""
+    if edge_a == edge_b and offset_b >= offset_a:
+        return offset_b - offset_a
+    remaining = float(net.edge_length_m[edge_a]) - offset_a
+    via = remaining + offset_b
+    if via > max_dist:
+        return float(UNREACHABLE)
+    src = int(net.edge_end[edge_a])
+    dst = int(net.edge_start[edge_b])
+    if cache is not None:
+        node_d = cache.distances_from(src, max_dist - via).get(dst)
+    else:
+        node_d = _dijkstra_bounded(net, src, max_dist - via).get(dst)
+    if node_d is None:
+        return float(UNREACHABLE)
+    return via + node_d
+
+
+def candidate_route_matrices(net: RoadNetwork, cands: CandidateSet,
+                             gc_dist: np.ndarray,
+                             max_route_distance_factor: float = 5.0,
+                             min_bound_m: float = 500.0,
+                             cache: Optional[RouteCache] = None) -> np.ndarray:
+    """(T-1, K, K) route-distance tensor between consecutive candidates.
+
+    ``gc_dist`` is the (T-1,) great-circle distance between consecutive
+    probes; the search bound per step is
+    ``max(min_bound_m, factor * gc_dist)`` mirroring the reference's
+    ``max-route-distance-factor`` cap (reference: Dockerfile:14-17).
+    """
+    T, K = cands.edge_ids.shape
+    if cache is None:
+        cache = RouteCache(net)
+    out = np.full((max(T - 1, 0), K, K), UNREACHABLE, dtype=np.float32)
+    for t in range(T - 1):
+        bound = max(min_bound_m, max_route_distance_factor * float(gc_dist[t]))
+        for i in range(K):
+            ea = int(cands.edge_ids[t, i])
+            if ea == PAD_EDGE:
+                continue
+            oa = float(cands.offset_m[t, i])
+            for j in range(K):
+                eb = int(cands.edge_ids[t + 1, j])
+                if eb == PAD_EDGE:
+                    continue
+                ob = float(cands.offset_m[t + 1, j])
+                out[t, i, j] = route_distance(net, ea, oa, eb, ob, bound, cache)
+    return out

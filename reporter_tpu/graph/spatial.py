@@ -1,0 +1,138 @@
+"""Spatial candidate lookup: probe point -> K nearest road edges.
+
+This is the host-side front half of the matcher. The reference delegates it
+to Valhalla's candidate search inside ``SegmentMatcher.Match``
+(reference: py/reporter_service.py:240); here it is a uniform grid over
+projected meters that emits **fixed-width (T, K) candidate tensors** ready to
+ship to the device — padded with sentinel values so every trace in a batch
+has identical shape.
+
+A numpy implementation lives here; the C++ host runtime (reporter_tpu.native)
+implements the same contract for throughput.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Tuple
+
+import numpy as np
+
+from .network import RoadNetwork
+
+PAD_EDGE = -1
+PAD_DIST = 1.0e9
+
+
+@dataclass
+class CandidateSet:
+    """Fixed-width candidates for one trace of T points, K per point.
+
+    Padding: ``edge_ids == PAD_EDGE`` marks unused slots; their ``dist_m``
+    is PAD_DIST so Gaussian emission scores underflow to ~-inf on device.
+    """
+    edge_ids: np.ndarray   # (T, K) i32
+    dist_m: np.ndarray     # (T, K) f32 point->edge distance
+    offset_m: np.ndarray   # (T, K) f32 along-edge offset of projection
+    proj_x: np.ndarray     # (T, K) f32 projected-point coords, meters
+    proj_y: np.ndarray     # (T, K) f32
+
+    @property
+    def T(self) -> int:
+        return self.edge_ids.shape[0]
+
+    @property
+    def K(self) -> int:
+        return self.edge_ids.shape[1]
+
+    def valid(self) -> np.ndarray:
+        return self.edge_ids != PAD_EDGE
+
+
+class SpatialGrid:
+    """Uniform grid over projected meters mapping cells -> edge ids."""
+
+    def __init__(self, net: RoadNetwork, cell_m: float = 250.0):
+        self.net = net
+        self.cell_m = float(cell_m)
+        nx, ny = net.node_xy()
+        self.ax = nx[net.edge_start]
+        self.ay = ny[net.edge_start]
+        self.bx = nx[net.edge_end]
+        self.by = ny[net.edge_end]
+        # segment direction and squared length, precomputed for projection
+        self.dx = self.bx - self.ax
+        self.dy = self.by - self.ay
+        self.len2 = np.maximum(self.dx * self.dx + self.dy * self.dy, 1e-9)
+
+        self.cells: Dict[Tuple[int, int], np.ndarray] = {}
+        lo_i = np.floor(np.minimum(self.ax, self.bx) / self.cell_m).astype(np.int64)
+        hi_i = np.floor(np.maximum(self.ax, self.bx) / self.cell_m).astype(np.int64)
+        lo_j = np.floor(np.minimum(self.ay, self.by) / self.cell_m).astype(np.int64)
+        hi_j = np.floor(np.maximum(self.ay, self.by) / self.cell_m).astype(np.int64)
+        buckets: Dict[Tuple[int, int], list] = {}
+        for e in range(net.num_edges):
+            for i in range(lo_i[e], hi_i[e] + 1):
+                for j in range(lo_j[e], hi_j[e] + 1):
+                    buckets.setdefault((i, j), []).append(e)
+        for key, ids in buckets.items():
+            self.cells[key] = np.asarray(ids, dtype=np.int32)
+
+    def _edges_near(self, x: float, y: float, radius_m: float) -> np.ndarray:
+        reach = int(np.ceil(radius_m / self.cell_m))
+        ci = int(np.floor(x / self.cell_m))
+        cj = int(np.floor(y / self.cell_m))
+        found = [
+            self.cells[(i, j)]
+            for i in range(ci - reach, ci + reach + 1)
+            for j in range(cj - reach, cj + reach + 1)
+            if (i, j) in self.cells
+        ]
+        if not found:
+            return np.empty(0, dtype=np.int32)
+        return np.unique(np.concatenate(found))
+
+    def candidates(self, lat: np.ndarray, lon: np.ndarray, k: int,
+                   search_radius_m: float = 50.0) -> CandidateSet:
+        """K nearest edges within ``search_radius_m`` for each probe point.
+
+        ``search_radius_m`` mirrors the matcher knob of the same name
+        (reference: Dockerfile:14-17, generate_test_trace.py:51).
+        """
+        to_xy, _ = self.net.projection()
+        px, py = to_xy(np.asarray(lat, dtype=np.float64),
+                       np.asarray(lon, dtype=np.float64))
+        px = np.atleast_1d(px).astype(np.float64)
+        py = np.atleast_1d(py).astype(np.float64)
+        T = len(px)
+
+        edge_ids = np.full((T, k), PAD_EDGE, dtype=np.int32)
+        dist_m = np.full((T, k), PAD_DIST, dtype=np.float32)
+        offset_m = np.zeros((T, k), dtype=np.float32)
+        proj_x = np.zeros((T, k), dtype=np.float32)
+        proj_y = np.zeros((T, k), dtype=np.float32)
+
+        for t in range(T):
+            near = self._edges_near(px[t], py[t], search_radius_m)
+            if near.size == 0:
+                continue
+            # project the point on each nearby edge segment
+            ax, ay = self.ax[near], self.ay[near]
+            frac = ((px[t] - ax) * self.dx[near] + (py[t] - ay) * self.dy[near]) \
+                / self.len2[near]
+            frac = np.clip(frac, 0.0, 1.0)
+            qx = ax + frac * self.dx[near]
+            qy = ay + frac * self.dy[near]
+            d = np.hypot(px[t] - qx, py[t] - qy)
+            inside = d <= search_radius_m
+            if not inside.any():
+                continue
+            near, frac, qx, qy, d = (arr[inside] for arr in (near, frac, qx, qy, d))
+            take = np.argsort(d, kind="stable")[:k]
+            n = len(take)
+            edge_ids[t, :n] = near[take]
+            dist_m[t, :n] = d[take]
+            offset_m[t, :n] = frac[take] * self.net.edge_length_m[near[take]]
+            proj_x[t, :n] = qx[take]
+            proj_y[t, :n] = qy[take]
+
+        return CandidateSet(edge_ids, dist_m, offset_m, proj_x, proj_y)

@@ -1,0 +1,202 @@
+"""Decoded candidate path -> OSMLR segment sequence (the match output).
+
+Produces the ``segment_matcher`` schema the reference's clients consume
+(reference: README.md "Reporter Output"; consumed by report() at
+py/reporter_service.py:103-162):
+
+  segments: [{segment_id?, way_ids, start_time, end_time, length,
+              queue_length, internal, begin_shape_index, end_shape_index}]
+
+Semantics preserved:
+- ``start_time == -1``  — the path got onto the segment mid-segment
+- ``end_time == -1``    — the path left the segment mid-segment
+- ``length == -1``      — the segment was not completely traversed
+- ``internal`` entries (turn channels etc.) carry no segment_id
+- entry/exit times are interpolated along the route between the two probe
+  points straddling the segment boundary.
+
+This walk is pure host-side post-processing over the device's decoded
+(T,) candidate indices; it runs per trace after the batched Viterbi.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ..graph.network import RoadNetwork
+from ..graph.route import UNREACHABLE
+from ..graph.spatial import PAD_EDGE
+from .hmm import RESTART
+
+# how close (meters) an observation must be to a segment boundary to count
+# as having been observed at the boundary itself
+_BOUNDARY_EPS = 1.0
+
+
+def _interp_time(pos: float, pos_a: float, pos_b: float,
+                 time_a: float, time_b: float) -> float:
+    if pos_b <= pos_a:
+        return float(time_a)
+    frac = (pos - pos_a) / (pos_b - pos_a)
+    frac = min(max(frac, 0.0), 1.0)
+    return float(time_a + frac * (time_b - time_a))
+
+
+class _Run:
+    """Consecutive decoded points on the same OSMLR segment (or the same
+    non-associated stretch)."""
+
+    __slots__ = ("segment_id", "internal", "first_idx", "last_idx",
+                 "first_pos", "last_pos", "first_time", "last_time",
+                 "first_cum", "last_cum", "edges",
+                 "start_time", "end_time")
+
+    def __init__(self, segment_id: Optional[int], internal: bool, idx: int,
+                 pos: float, time: float, cum: float, edge: int):
+        self.segment_id = segment_id
+        self.internal = internal
+        self.first_idx = self.last_idx = idx
+        self.first_pos = self.last_pos = pos
+        self.first_time = self.last_time = time
+        self.first_cum = self.last_cum = cum
+        self.edges = [edge]
+        self.start_time: float = -1.0
+        self.end_time: float = -1.0
+
+    def extend(self, idx: int, pos: float, time: float, cum: float, edge: int):
+        self.last_idx = idx
+        self.last_pos = pos
+        self.last_time = time
+        self.last_cum = cum
+        if self.edges[-1] != edge:
+            self.edges.append(edge)
+
+
+def assemble_segments(net: RoadNetwork, prepared, path: np.ndarray,
+                      mode: str = "auto") -> dict:
+    """Build the match dict for one trace.
+
+    ``prepared`` is a PreparedTrace (host tensors incl. times);
+    ``path`` is the device-decoded (T,) candidate index per point.
+    """
+    times = prepared.times
+    case = prepared.case
+
+    segments: List[dict] = []
+
+    # walk chains of kept points, split at RESTART boundaries; excluded
+    # points (jitter/no-candidate) fall inside the surrounding runs' index
+    # spans and need no explicit handling here
+    chain: List[tuple] = []  # (orig_idx, edge, seg_id, seg_pos, time, cum)
+
+    def flush_chain():
+        if chain:
+            segments.extend(_chain_to_segments(net, chain))
+        chain.clear()
+
+    cum = 0.0
+    prev_t = None
+    for t in range(prepared.num_kept):
+        orig = int(prepared.kept_idx[t])
+        if case[t] == RESTART:
+            flush_chain()
+            cum = 0.0
+            prev_t = None
+        k = int(path[t])
+        edge = int(prepared.edge_ids[t, k])
+        if edge == PAD_EDGE:
+            flush_chain()
+            prev_t = None
+            continue
+        if prev_t is not None:
+            step = float(prepared.route_m[t - 1, int(path[t - 1]), k])
+            if step >= UNREACHABLE / 2:
+                # decoder was forced through an unroutable pair; break here
+                flush_chain()
+                cum = 0.0
+            else:
+                cum += step
+        seg_id = int(net.edge_segment_id[edge])
+        seg_pos = float(net.edge_segment_offset_m[edge]) + \
+            float(prepared.offset_m[t, k])
+        chain.append((orig, edge, seg_id, seg_pos, float(times[orig]), cum))
+        prev_t = t
+    flush_chain()
+
+    return {"segments": segments, "mode": mode}
+
+
+def _chain_to_segments(net: RoadNetwork, chain: List[tuple]) -> List[dict]:
+    # group the chain into runs of one segment (or one unassociated stretch)
+    runs: List[_Run] = []
+    for idx, edge, seg_id, seg_pos, time, cum in chain:
+        internal = bool(net.edge_internal[edge])
+        sid = seg_id if seg_id >= 0 else None
+        same = (
+            runs
+            and runs[-1].segment_id == sid
+            and runs[-1].internal == internal
+            # a re-entry onto the same segment starts a new run
+            and not (sid is not None and seg_pos < runs[-1].last_pos - _BOUNDARY_EPS)
+        )
+        if same:
+            runs[-1].extend(idx, seg_pos, time, cum, edge)
+        else:
+            runs.append(_Run(sid, internal, idx, seg_pos, time, cum, edge))
+
+    # interpolate boundary times between adjacent runs
+    for a, b in zip(runs[:-1], runs[1:]):
+        # time as a function of cumulative route position between the two
+        # probes straddling the boundary
+        pos_a, pos_b = a.last_cum, b.first_cum
+        ta, tb = a.last_time, b.first_time
+        if a.segment_id is not None:
+            seg_len = net.segment_length_m.get(a.segment_id, 0.0)
+            exit_cum = a.last_cum + max(seg_len - a.last_pos, 0.0)
+            a.end_time = _interp_time(exit_cum, pos_a, pos_b, ta, tb)
+        else:
+            a.end_time = ta
+        if b.segment_id is not None:
+            entry_cum = b.first_cum - b.first_pos
+            b.start_time = _interp_time(entry_cum, pos_a, pos_b, ta, tb)
+        else:
+            b.start_time = tb
+
+    # chain endpoints: partial entry/exit => -1 sentinels
+    if runs:
+        first = runs[0]
+        if first.segment_id is not None and first.first_pos <= _BOUNDARY_EPS:
+            first.start_time = first.first_time
+        elif first.segment_id is None:
+            first.start_time = first.first_time
+        # else stays -1 (got on mid-segment)
+        last = runs[-1]
+        if last.segment_id is not None:
+            seg_len = net.segment_length_m.get(last.segment_id, 0.0)
+            if last.last_pos >= seg_len - _BOUNDARY_EPS:
+                last.end_time = last.last_time
+            # else stays -1 (still on the segment when the trace ended)
+        else:
+            last.end_time = last.last_time
+
+    out = []
+    for r in runs:
+        complete = r.segment_id is not None \
+            and r.start_time != -1.0 and r.end_time != -1.0
+        seg_len = net.segment_length_m.get(r.segment_id, -1.0) \
+            if r.segment_id is not None else -1.0
+        entry = {
+            "way_ids": [int(e) for e in r.edges],
+            "start_time": round(r.start_time, 3),
+            "end_time": round(r.end_time, 3),
+            "length": int(round(seg_len)) if complete else -1,
+            "queue_length": 0,
+            "internal": r.internal,
+            "begin_shape_index": int(r.first_idx),
+            "end_shape_index": int(r.last_idx),
+        }
+        if r.segment_id is not None:
+            entry["segment_id"] = int(r.segment_id)
+        out.append(entry)
+    return out

@@ -1,0 +1,182 @@
+"""Host-side trace preparation: candidates, route tensors, padding buckets.
+
+Two pieces of irregularity are resolved here so the device program stays
+fixed-shape and branch-free (SURVEY.md §7 "Hard parts: raggedness"):
+
+1. **Point filtering.** Probe points closer than ``interpolation_distance``
+   to the last kept point (GPS jitter while slow/stopped) and points with no
+   candidate edges are *excluded* from the HMM; the Viterbi runs over the
+   kept subsequence only, and excluded points are attributed to the decoded
+   runs afterwards. This mirrors Meili's interpolation behavior and is what
+   keeps backward-jitter from reading as a u-turn.
+
+2. **Bucketed padding.** Kept subsequences are padded to the smallest bucket
+   in ``LENGTH_BUCKETS`` so XLA compiles a handful of shapes, not thousands.
+"""
+from __future__ import annotations
+
+import bisect
+from dataclasses import dataclass
+from typing import List, Sequence
+
+import numpy as np
+
+from ..core.geo import equirectangular_m
+from ..graph.network import RoadNetwork
+from ..graph.route import RouteCache, candidate_route_matrices, UNREACHABLE
+from ..graph.spatial import CandidateSet, SpatialGrid, PAD_EDGE, PAD_DIST
+from .hmm import NORMAL, RESTART, SKIP
+from .params import MatchParams
+
+LENGTH_BUCKETS = (16, 64, 256, 1024)
+
+
+def bucket_length(n: int) -> int:
+    """Smallest bucket >= n (the last bucket caps the trace length)."""
+    idx = bisect.bisect_left(LENGTH_BUCKETS, n)
+    return LENGTH_BUCKETS[min(idx, len(LENGTH_BUCKETS) - 1)]
+
+
+@dataclass
+class PreparedTrace:
+    """One trace's fixed-width tensors, padded to bucket length T.
+
+    Tensor rows 0..num_kept-1 correspond to the *kept* points;
+    ``kept_idx`` maps them back to indices in the original trace.
+    """
+    num_raw: int           # points in the original trace
+    num_kept: int          # points included in the HMM
+    kept_idx: np.ndarray   # (num_kept,) i32 original indices
+    times: np.ndarray      # (num_raw,) f64 epoch seconds
+    edge_ids: np.ndarray   # (T, K) i32
+    dist_m: np.ndarray     # (T, K) f32
+    offset_m: np.ndarray   # (T, K) f32
+    route_m: np.ndarray    # (T-1, K, K) f32
+    gc_m: np.ndarray       # (T-1,) f32
+    case: np.ndarray       # (T,) i32
+
+    @property
+    def T(self) -> int:
+        return self.edge_ids.shape[0]
+
+
+def _select_kept(lat, lon, has_cands, interpolation_distance):
+    """Indices of points that enter the HMM: drop candidate-less points and
+    points within ``interpolation_distance`` of the last kept point."""
+    kept = []
+    for i in range(len(lat)):
+        if not has_cands[i]:
+            continue
+        if kept:
+            gc = equirectangular_m(lat[kept[-1]], lon[kept[-1]], lat[i], lon[i])
+            if gc < interpolation_distance:
+                continue
+        kept.append(i)
+    return np.asarray(kept, dtype=np.int32)
+
+
+def prepare_trace(net: RoadNetwork, grid: SpatialGrid, points: Sequence[dict],
+                  params: MatchParams,
+                  cache: RouteCache | None = None) -> PreparedTrace:
+    """Candidates + route tensors + case codes for one trace, padded."""
+    num_raw = len(points)
+    lat = np.array([p["lat"] for p in points], dtype=np.float64)
+    lon = np.array([p["lon"] for p in points], dtype=np.float64)
+    times = np.array([p["time"] for p in points], dtype=np.float64)
+    K = params.max_candidates
+
+    all_cands = grid.candidates(lat, lon, K, params.search_radius)
+    has_cands = (all_cands.edge_ids != PAD_EDGE).any(axis=1)
+    kept = _select_kept(lat, lon, has_cands, params.interpolation_distance)
+    n = len(kept)
+    T = bucket_length(max(n, 1))
+    if n > T:  # cap at the largest bucket
+        kept = kept[:T]
+        n = T
+
+    cands = CandidateSet(
+        edge_ids=all_cands.edge_ids[kept], dist_m=all_cands.dist_m[kept],
+        offset_m=all_cands.offset_m[kept], proj_x=all_cands.proj_x[kept],
+        proj_y=all_cands.proj_y[kept])
+
+    gc = equirectangular_m(lat[kept[:-1]], lon[kept[:-1]],
+                           lat[kept[1:]], lon[kept[1:]]) if n > 1 else np.zeros(0)
+    gc = np.atleast_1d(np.asarray(gc, dtype=np.float32))
+
+    route = candidate_route_matrices(
+        net, cands, gc,
+        max_route_distance_factor=params.max_route_distance_factor,
+        cache=cache)
+
+    # case codes over kept points: RESTART at the first point and after
+    # breakage-sized gaps; SKIP only in the padding tail
+    case = np.full(T, SKIP, dtype=np.int32)
+    for t in range(n):
+        if t == 0 or gc[t - 1] > params.breakage_distance:
+            case[t] = RESTART
+        else:
+            case[t] = NORMAL
+
+    # pad to bucket
+    edge_ids = np.full((T, K), PAD_EDGE, dtype=np.int32)
+    dist = np.full((T, K), PAD_DIST, dtype=np.float32)
+    offset = np.zeros((T, K), dtype=np.float32)
+    route_p = np.full((max(T - 1, 0), K, K), UNREACHABLE, dtype=np.float32)
+    gc_p = np.zeros(max(T - 1, 0), dtype=np.float32)
+
+    edge_ids[:n] = cands.edge_ids
+    dist[:n] = cands.dist_m
+    offset[:n] = cands.offset_m
+    if n > 1:
+        route_p[:n - 1] = route
+        gc_p[:n - 1] = gc
+
+    return PreparedTrace(num_raw=num_raw, num_kept=n, kept_idx=kept,
+                         times=times, edge_ids=edge_ids, dist_m=dist,
+                         offset_m=offset, route_m=route_p, gc_m=gc_p,
+                         case=case)
+
+
+@dataclass
+class PaddedBatch:
+    """A device-ready batch of same-bucket traces."""
+    traces: List[PreparedTrace]
+    dist_m: np.ndarray   # (B, T, K) f32
+    valid: np.ndarray    # (B, T, K) bool
+    route_m: np.ndarray  # (B, T-1, K, K) f32
+    gc_m: np.ndarray     # (B, T-1) f32
+    case: np.ndarray     # (B, T) i32
+
+
+def pack_batches(prepared: Sequence[PreparedTrace],
+                 pad_batch_to: int | None = None) -> List[PaddedBatch]:
+    """Group prepared traces by bucket length and stack into batches.
+
+    ``pad_batch_to`` optionally rounds the batch dimension up to a multiple
+    (useful to keep the compiled-shape count low in a long-running service);
+    filler rows are all-SKIP traces that decode to nothing.
+    """
+    by_T: dict[int, List[PreparedTrace]] = {}
+    for p in prepared:
+        by_T.setdefault(p.T, []).append(p)
+
+    batches = []
+    for T, group in sorted(by_T.items()):
+        B = len(group)
+        if pad_batch_to:
+            B = ((B + pad_batch_to - 1) // pad_batch_to) * pad_batch_to
+        K = group[0].edge_ids.shape[1]
+        dist = np.full((B, T, K), PAD_DIST, dtype=np.float32)
+        valid = np.zeros((B, T, K), dtype=bool)
+        route = np.full((B, max(T - 1, 0), K, K), UNREACHABLE, dtype=np.float32)
+        gc = np.zeros((B, max(T - 1, 0)), dtype=np.float32)
+        case = np.full((B, T), SKIP, dtype=np.int32)
+        for b, p in enumerate(group):
+            dist[b] = p.dist_m
+            valid[b] = p.edge_ids != PAD_EDGE
+            route[b] = p.route_m
+            gc[b] = p.gc_m
+            case[b] = p.case
+        batches.append(PaddedBatch(traces=group, dist_m=dist, valid=valid,
+                                   route_m=route, gc_m=gc, case=case))
+    return batches

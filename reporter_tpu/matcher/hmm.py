@@ -1,0 +1,126 @@
+"""The batched HMM map matcher: emission/transition scoring + Viterbi on device.
+
+This replaces the reference's per-trace C++ Meili matcher
+(reference: py/reporter_service.py:52,240 — ``valhalla.SegmentMatcher.Match``,
+one trace per call, one C++ instance per service thread). Here the whole
+batch decodes in one XLA program:
+
+- emission score of candidate k at point t: log N(dist | 0, sigma_z)
+  with constants dropped -> ``-0.5 * (d / sigma)^2``
+- transition score between candidates (i, j) of consecutive points:
+  ``-|route_dist - great_circle| / beta`` (exponential deviation model)
+- Viterbi decode as a ``lax.scan`` over time, ``vmap`` over the batch.
+
+Everything is fixed-shape: traces padded to T points, K candidates. Control
+flow that depends on data (probe gaps > breakage_distance, points with no
+candidates, padding) is encoded host-side as a per-point ``case`` tensor:
+
+  NORMAL  — standard Viterbi step
+  RESTART — chain restarts here (first kept point, or after a breakage
+            split; reference knob ``breakage_distance``, Dockerfile:14-17)
+  SKIP    — padding tail; state passes through untouched
+
+(points with no candidates, and jitter points under the interpolation
+distance, are filtered out host-side before tensors are built — see
+``batchpad.prepare_trace``)
+
+so the scan body is branch-free ``jnp.where`` selects — XLA-friendly, no
+data-dependent Python control flow.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = jnp.float32(-1.0e30)
+NORMAL, RESTART, SKIP = 0, 1, 2
+# route distances at/above this threshold are "no route found within bound"
+UNREACHABLE_THRESHOLD = 0.5e9
+
+
+def emission_scores(dist_m: jnp.ndarray, valid: jnp.ndarray,
+                    case: jnp.ndarray, sigma: jnp.ndarray) -> jnp.ndarray:
+    """(T, K) emission log-scores.
+
+    ``dist_m`` point->edge distances, ``valid`` candidate mask, ``case``
+    per-point case codes, ``sigma`` scalar effective sigma_z.
+    SKIP rows become all-zero so they never poison the running scores.
+    """
+    z = dist_m / sigma
+    scores = jnp.where(valid, -0.5 * z * z, NEG_INF)
+    return jnp.where((case == SKIP)[:, None], 0.0, scores)
+
+
+def transition_scores(route_m: jnp.ndarray, gc_m: jnp.ndarray,
+                      case_to: jnp.ndarray, beta: jnp.ndarray) -> jnp.ndarray:
+    """(T-1, K, K) transition log-scores for steps into points 1..T-1.
+
+    Steps into a SKIP point use the identity matrix (0 on the diagonal,
+    -inf off it) so the chain state is carried through unchanged. Steps into
+    a RESTART point are zeroed (the scan ignores them). Unreachable route
+    distances become -inf.
+    """
+    K = route_m.shape[-1]
+    dev = jnp.abs(route_m - gc_m[:, None, None])
+    scores = jnp.where(route_m < UNREACHABLE_THRESHOLD, -dev / beta, NEG_INF)
+    identity = jnp.where(jnp.eye(K, dtype=bool), 0.0, NEG_INF)
+    scores = jnp.where((case_to == SKIP)[:, None, None], identity[None], scores)
+    return jnp.where((case_to == RESTART)[:, None, None], 0.0, scores)
+
+
+def _viterbi_single(em: jnp.ndarray, tr: jnp.ndarray, case: jnp.ndarray):
+    """Viterbi forward + backtrace for one trace.
+
+    em: (T, K) emission scores; tr: (T-1, K, K) transition scores;
+    case: (T,) case codes. Returns (path (T,) i32, final score f32).
+    """
+    T, K = em.shape
+
+    def forward(prev_scores, inp):
+        em_t, tr_t, case_t = inp
+        cand = prev_scores[:, None] + tr_t           # (K_prev, K_cur)
+        best = jnp.max(cand, axis=0)
+        bp = jnp.argmax(cand, axis=0).astype(jnp.int32)
+        stepped = best + em_t
+        restarted = em_t
+        new_scores = jnp.where(case_t == RESTART, restarted, stepped)
+        # argmax of the chain state *before* this step, for restart backtrace
+        prev_best = jnp.argmax(prev_scores).astype(jnp.int32)
+        return new_scores, (bp, prev_best)
+
+    init = em[0]
+    final_scores, (bps, prev_bests) = jax.lax.scan(
+        forward, init, (em[1:], tr, case[1:]))
+
+    last = jnp.argmax(final_scores).astype(jnp.int32)
+
+    def backward(cur, inp):
+        bp_t, prev_best_t, case_t = inp
+        prev = jnp.where(case_t == RESTART, prev_best_t, bp_t[cur])
+        return prev, cur
+
+    first, rest = jax.lax.scan(
+        backward, last, (bps, prev_bests, case[1:]), reverse=True)
+    path = jnp.concatenate([first[None], rest])
+    return path, jnp.max(final_scores)
+
+
+@functools.partial(jax.jit, static_argnames=())
+def viterbi_decode_batch(dist_m: jnp.ndarray, valid: jnp.ndarray,
+                         route_m: jnp.ndarray, gc_m: jnp.ndarray,
+                         case: jnp.ndarray, sigma: jnp.ndarray,
+                         beta: jnp.ndarray):
+    """Decode a padded batch of traces.
+
+    Shapes: dist_m (B,T,K) f32; valid (B,T,K) bool; route_m (B,T-1,K,K) f32;
+    gc_m (B,T-1) f32; case (B,T) i32; sigma, beta scalars (f32).
+    Returns (paths (B,T) i32 candidate indices, scores (B,) f32).
+    """
+    def one(d, v, r, g, c):
+        em = emission_scores(d, v, c, sigma)
+        tr = transition_scores(r, g, c[1:], beta)
+        return _viterbi_single(em, tr, c)
+
+    return jax.vmap(one)(dist_m, valid, route_m, gc_m, case)

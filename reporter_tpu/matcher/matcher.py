@@ -1,0 +1,118 @@
+"""SegmentMatcher: the framework's matcher facade.
+
+API-compatible with the surface the reference uses from the ``valhalla``
+extension module (reference: py/reporter_service.py:21,52,240 and
+py/simple_reporter.py:132-133):
+
+    Configure(config_path_or_dict)
+    m = SegmentMatcher()
+    match_json = m.Match(trace_json_str)
+
+plus the batched entry point the reference lacks — ``match_many`` — which is
+the TPU hot path: many traces prepared on host, decoded in one vmapped
+Viterbi per padding bucket.
+"""
+from __future__ import annotations
+
+import json
+import threading
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..graph.network import RoadNetwork
+from ..graph.route import RouteCache
+from ..graph.spatial import SpatialGrid
+from .assemble import assemble_segments
+from .batchpad import pack_batches, prepare_trace
+from .hmm import viterbi_decode_batch
+from .params import MatchParams
+
+# process-wide configuration, mirroring valhalla.Configure's module-level
+# behavior (reference: reporter_service.py:284)
+_configured = threading.local()
+_global_config: dict = {}
+
+
+def Configure(conf) -> None:
+    """Load matcher configuration from a JSON file path or a dict.
+
+    Recognised keys (all optional): ``graph`` (path to a RoadNetwork .npz),
+    and any MatchParams field under ``matcher`` (sigma_z, beta, ...).
+    """
+    global _global_config
+    if isinstance(conf, str):
+        with open(conf) as f:
+            _global_config = json.load(f)
+    else:
+        _global_config = dict(conf)
+
+
+class SegmentMatcher:
+    """Batched HMM matcher bound to one road network.
+
+    Thread-safe for concurrent Match calls (the reference instead creates
+    one C++ matcher per service thread, reporter_service.py:51-58; here a
+    single instance serves all threads and the service batches across them).
+    """
+
+    def __init__(self, net: Optional[RoadNetwork] = None,
+                 params: Optional[MatchParams] = None,
+                 grid_cell_m: float = 250.0):
+        if net is None:
+            graph_path = _global_config.get("graph")
+            if graph_path is None:
+                raise ValueError(
+                    "no network: pass net= or Configure({'graph': path})")
+            net = RoadNetwork.load(graph_path)
+        self.net = net
+        if params is None:
+            params = MatchParams(**_global_config.get("matcher", {}))
+        self.params = params
+        self.grid = SpatialGrid(net, cell_m=grid_cell_m)
+        self.route_cache = RouteCache(net)
+        self._lock = threading.Lock()
+
+    # -- single-trace, reference-shaped API --------------------------------
+    def Match(self, trace_json: str) -> str:
+        trace = json.loads(trace_json)
+        result = self.match_many([trace])[0]
+        return json.dumps(result, separators=(",", ":"))
+
+    # -- batched hot path --------------------------------------------------
+    def match_many(self, traces: Sequence[dict]) -> List[dict]:
+        """Match a batch of trace dicts; returns match dicts in order.
+
+        Each trace: {"uuid": ..., "trace": [{lat, lon, time, ...}, ...],
+        "match_options": {...}} — per-trace match_options may override
+        params (reference: generate_test_trace.py:45-52).
+        """
+        prepared = []
+        per_trace_params = []
+        for tr in traces:
+            params = self.params.with_options(tr.get("match_options", {}))
+            per_trace_params.append(params)
+            prepared.append(prepare_trace(
+                self.net, self.grid, tr["trace"], params, self.route_cache))
+
+        # decode bucket by bucket; map paths back to input order
+        paths: dict[int, np.ndarray] = {}
+        index_of = {id(p): i for i, p in enumerate(prepared)}
+        for batch in pack_batches(prepared):
+            # sigma/beta are batch-wide; per-trace overrides of the scoring
+            # scalars fall back to the first trace's values in this batch
+            p0 = per_trace_params[index_of[id(batch.traces[0])]]
+            decoded, _scores = viterbi_decode_batch(
+                batch.dist_m, batch.valid, batch.route_m, batch.gc_m,
+                batch.case,
+                np.float32(p0.effective_sigma), np.float32(p0.beta))
+            decoded = np.asarray(decoded)
+            for b, ptrace in enumerate(batch.traces):
+                paths[index_of[id(ptrace)]] = decoded[b]
+
+        results = []
+        for i, (tr, ptrace) in enumerate(zip(traces, prepared)):
+            mode = per_trace_params[i].mode
+            results.append(
+                assemble_segments(self.net, ptrace, paths[i], mode=mode))
+        return results

@@ -1,0 +1,44 @@
+"""Matcher tuning knobs, named after the reference's configuration keys.
+
+Defaults mirror the reference deployment (reference: Dockerfile:14-17,
+py/generate_test_trace.py:45-52): sigma_z 4.07, beta 3,
+max-route-distance-factor 5, search_radius 50 m, breakage_distance 2000 m.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+
+
+@dataclass(frozen=True)
+class MatchParams:
+    mode: str = "auto"
+    sigma_z: float = 4.07              # emission Gaussian std, meters
+    beta: float = 3.0                  # transition exponential scale
+    max_route_distance_factor: float = 5.0
+    max_route_time_factor: float = 2.0
+    breakage_distance: float = 2000.0  # meters; larger probe gaps split the HMM
+    search_radius: float = 50.0        # meters candidate search radius
+    turn_penalty_factor: float = 0.0
+    gps_accuracy: float = 0.0          # >0 widens sigma to at least accuracy/1.96
+    max_candidates: int = 8            # K, fixed width of candidate tensors
+    # points closer than this to the last kept point are excluded from the
+    # HMM and interpolated onto the decoded path afterwards — Meili's cure
+    # for GPS jitter flipping the matched direction of travel
+    interpolation_distance: float = 10.0
+
+    def with_options(self, options: dict) -> "MatchParams":
+        """Apply per-request ``match_options`` overrides by reference name
+        (reference: generate_test_trace.py:45-52)."""
+        fields = {}
+        for key in ("mode", "sigma_z", "beta", "breakage_distance",
+                    "search_radius", "turn_penalty_factor", "gps_accuracy",
+                    "max_route_distance_factor", "max_route_time_factor"):
+            if key in options:
+                fields[key] = options[key]
+        return replace(self, **fields) if fields else self
+
+    @property
+    def effective_sigma(self) -> float:
+        if self.gps_accuracy and self.gps_accuracy > 0:
+            return max(self.sigma_z, self.gps_accuracy / 1.96)
+        return self.sigma_z

@@ -1,0 +1,4 @@
+from .report import report
+from .dispatch import BatchDispatcher
+
+__all__ = ["report", "BatchDispatcher"]

@@ -1,0 +1,92 @@
+"""Micro-batching dispatcher: many concurrent requests -> one device batch.
+
+The reference serves one trace per HTTP request with one C++ matcher per
+thread (reference: py/reporter_service.py:32-64). The TPU inverts that
+economy: the device wants *large* batches. This dispatcher is the bridge —
+request threads enqueue traces and block; a single dispatch loop drains the
+queue into a batch (flushing on ``max_batch`` or ``max_wait_ms`` since the
+first pending trace, whichever first), runs the batched matcher, and wakes
+each requester with its own result.
+
+This is the micro-batch buffer SURVEY.md §2.4 calls the north-star addition.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Callable, List, Optional, Sequence
+
+
+class _Slot:
+    __slots__ = ("trace", "event", "result", "error")
+
+    def __init__(self, trace: dict):
+        self.trace = trace
+        self.event = threading.Event()
+        self.result: Optional[dict] = None
+        self.error: Optional[Exception] = None
+
+
+class BatchDispatcher:
+    """Accumulates traces and runs ``match_many`` over the accumulated batch.
+
+    ``match_many``: callable taking a list of trace dicts and returning a
+    list of match dicts (e.g. ``SegmentMatcher.match_many``).
+    """
+
+    def __init__(self, match_many: Callable[[Sequence[dict]], List[dict]],
+                 max_batch: int = 256, max_wait_ms: float = 20.0):
+        self._match_many = match_many
+        self.max_batch = max_batch
+        self.max_wait = max_wait_ms / 1000.0
+        self._queue: "queue.Queue[_Slot]" = queue.Queue()
+        self._closed = False
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="match-dispatch")
+        self._thread.start()
+
+    # ---- request side ----------------------------------------------------
+    def submit(self, trace: dict, timeout: float = 60.0) -> dict:
+        """Block until the trace's match result is ready."""
+        if self._closed:
+            raise RuntimeError("dispatcher is closed")
+        slot = _Slot(trace)
+        self._queue.put(slot)
+        if not slot.event.wait(timeout):
+            raise TimeoutError("match result not ready in time")
+        if slot.error is not None:
+            raise slot.error
+        return slot.result  # type: ignore[return-value]
+
+    # ---- dispatch loop ---------------------------------------------------
+    def _drain_batch(self) -> List[_Slot]:
+        """Block for the first trace, then collect until flush conditions."""
+        slots = [self._queue.get()]
+        t0 = time.monotonic()
+        while len(slots) < self.max_batch:
+            remaining = self.max_wait - (time.monotonic() - t0)
+            if remaining <= 0:
+                break
+            try:
+                slots.append(self._queue.get(timeout=remaining))
+            except queue.Empty:
+                break
+        return slots
+
+    def _loop(self):
+        while not self._closed:
+            slots = self._drain_batch()
+            try:
+                results = self._match_many([s.trace for s in slots])
+                for slot, res in zip(slots, results):
+                    slot.result = res
+            except Exception as e:  # propagate to every waiter in the batch
+                for slot in slots:
+                    slot.error = e
+            finally:
+                for slot in slots:
+                    slot.event.set()
+
+    def close(self):
+        self._closed = True

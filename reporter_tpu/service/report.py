@@ -1,0 +1,157 @@
+"""Datastore report generation from matcher output.
+
+Behavioral port of the reference's ``report()``
+(reference: py/reporter_service.py:79-179) — this is the compatibility
+contract between the matcher and every downstream consumer (the Java
+worker's ``forward()`` at BatchingProcessor.java:108-141 and the batch
+pipeline at simple_reporter.py:168-177). Preserved semantics:
+
+- trailing holdback: segments whose start_time is within ``threshold_sec``
+  of the trace end are withheld (the vehicle may still be on them), and
+  ``shape_used`` marks how much of the trace may be trimmed
+- emission is *pairwise*: a segment is reported only once its successor is
+  known; ``t1`` is the successor's start time when the successor's level is
+  in ``transition_levels``, else the segment's own end time
+- internal segments (turn channels, roundabouts) never clear the pending
+  prior segment — they are bridged over
+- validity: positive finite dt and speed <= 160 km/h
+- the stats block (successful/unreported counts, discontinuities, invalid
+  times/speeds, unassociated segments)
+
+One deliberate deviation: the reference *assigns* the last segment's km to
+the stats ``length`` fields instead of accumulating
+(reporter_service.py:138,142); here lengths are summed, which is the
+evident intent of the telemetry.
+"""
+from __future__ import annotations
+
+import math
+from typing import Iterable, Optional
+
+
+class _Pending:
+    """The prior segment awaiting its successor before being reported."""
+
+    __slots__ = ("segment_id", "start_time", "end_time", "length",
+                 "queue_length", "level", "internal")
+
+    def __init__(self, seg: dict, level: int):
+        self.segment_id = seg.get("segment_id")
+        self.start_time = seg.get("start_time")
+        self.end_time = seg.get("end_time")
+        self.length = seg.get("length")
+        self.queue_length = seg.get("queue_length")
+        self.level = level
+        self.internal = seg.get("internal", False)
+
+
+def report(match: dict, trace: dict, threshold_sec: float,
+           report_levels: Iterable[int],
+           transition_levels: Iterable[int]) -> dict:
+    """Turn a match result into datastore reports + stats."""
+    report_levels = set(report_levels)
+    transition_levels = set(transition_levels)
+    segs = match["segments"]
+    trace_end = trace["trace"][-1]["time"]
+
+    # ---- trailing holdback (reference: reporter_service.py:83-92) --------
+    last_idx = len(segs) - 1
+    while last_idx >= 0 and \
+            trace_end - segs[last_idx]["start_time"] < threshold_sec:
+        last_idx -= 1
+    shape_used: Optional[int] = None
+    if last_idx >= 0:
+        shape_used = segs[last_idx]["begin_shape_index"]
+
+    match["mode"] = "auto"
+    reports = []
+    stats = {
+        "successful": 0, "successful_km": 0.0,
+        "unreported": 0, "unreported_km": 0.0,
+        "discontinuities": 0, "invalid_times": 0, "invalid_speeds": 0,
+        "unassociated": 0,
+    }
+
+    pending: Optional[_Pending] = None
+    first = True
+    for idx in range(last_idx + 1):
+        seg = segs[idx]
+        seg_id = seg.get("segment_id")
+        internal = seg.get("internal", False)
+        start_time = seg.get("start_time")
+
+        # a partial end followed by a partial start marks a discontinuity
+        # (reference: reporter_service.py:114-116)
+        if idx > 0 and start_time == -1 and segs[idx - 1]["end_time"] == -1:
+            stats["discontinuities"] += 1
+
+        level = (seg_id & 0x7) if seg_id is not None else -1
+
+        # emit the pending segment now that its successor is visible;
+        # an internal successor defers emission (reference: :122-127)
+        if pending is not None and pending.segment_id is not None \
+                and pending.length is not None \
+                and pending.length > 0 and not internal:
+            if pending.level in report_levels:
+                t1 = start_time if level in transition_levels \
+                    else pending.end_time
+                entry = {
+                    "id": pending.segment_id,
+                    "t0": pending.start_time,
+                    "t1": t1,
+                    "length": pending.length,
+                    "queue_length": pending.queue_length,
+                }
+                if level in transition_levels and seg_id is not None:
+                    entry["next_id"] = seg_id
+                dt = float(entry["t1"]) - float(entry["t0"])
+                if dt <= 0 or math.isinf(dt) or math.isnan(dt):
+                    stats["invalid_times"] += 1
+                elif (pending.length / dt) * 3.6 > 160:
+                    stats["invalid_speeds"] += 1
+                else:
+                    reports.append(entry)
+                    stats["successful"] += 1
+                    stats["successful_km"] += round(pending.length * 0.001, 3)
+            else:
+                stats["unreported"] += 1
+                stats["unreported_km"] += round(pending.length * 0.001, 3)
+
+        # internal segments bridge: keep the pending prior
+        # (reference: :144-156)
+        if internal and not first:
+            if pending is not None:
+                pending.internal = True
+        else:
+            pending = _Pending(seg, level)
+        first = False
+
+        # service roads etc: matched edges with no OSMLR id
+        # (reference: :159-162)
+        if seg_id is None and not internal:
+            stats["unassociated"] += 1
+
+    out = {
+        "stats": {
+            "successful_matches": {
+                "count": stats["successful"],
+                "length": round(stats["successful_km"], 3),
+            },
+            "unreported_matches": {
+                "count": stats["unreported"],
+                "length": round(stats["unreported_km"], 3),
+            },
+            "match_errors": {
+                "discontinuities": stats["discontinuities"],
+                "invalid_speeds": stats["invalid_speeds"],
+                "invalid_times": stats["invalid_times"],
+            },
+            "unassociated_segments": stats["unassociated"],
+        },
+    }
+    # reference quirk preserved: shape_used omitted when falsy (index 0)
+    if shape_used:
+        out["shape_used"] = shape_used
+    out["segment_matcher"] = match
+    out["datastore"] = {"mode": "auto", "reports": reports}
+    return out

@@ -1,0 +1,6 @@
+from .formatter import Formatter
+from .batcher import Batch, PointBatcher
+from .anonymiser import Anonymiser
+from .broker import InMemoryBroker
+
+__all__ = ["Formatter", "Batch", "PointBatcher", "Anonymiser", "InMemoryBroker"]

@@ -1,0 +1,174 @@
+"""Segment accumulation, privacy culling, and tile egress.
+
+The streaming analog of the reference's AnonymisingProcessor
+(reference: AnonymisingProcessor.java). Semantics preserved:
+
+- each segment observation is appended to every (time bucket, graph tile)
+  slice it touches (AnonymisingProcessor.java:120-153), slices capped at
+  20,000 segments (the reference's Kafka ~1MB value cap, :32-45)
+- on each flush interval, slices per tile are gathered, sorted by
+  (id, next_id), and runs of identical pairs shorter than the privacy
+  threshold are removed (:155-175, :223-266)
+- surviving tiles are written as CSV with the reference's column layout to
+  S3 / HTTP POST / local files, under
+  ``{t0}_{t1}/{level}/{tile_index}/{source}.{uuid4}`` (:177-220)
+
+S3 egress uses boto3 when available (gated — this image has no network),
+falling back to an error log, mirroring the reference's swallow-and-log
+egress failures (HttpClient.java:95-98).
+"""
+from __future__ import annotations
+
+import logging
+import os
+import urllib.request
+import uuid as uuidlib
+from typing import Dict, List
+
+from ..core.types import Segment, TimeQuantisedTile
+
+logger = logging.getLogger("reporter_tpu.streaming")
+
+SLICE_SIZE = 20000  # reference: AnonymisingProcessor.java:45
+
+
+def privacy_cull(segments: List[Segment], privacy: int) -> List[Segment]:
+    """Drop runs of identical (id, next_id) pairs shorter than ``privacy``.
+
+    Input must be sorted by (id, next_id)
+    (reference: AnonymisingProcessor.java:155-175).
+    """
+    out: List[Segment] = []
+    i = 0
+    n = len(segments)
+    while i < n:
+        j = i
+        while j < n and segments[j].sort_key() == segments[i].sort_key():
+            j += 1
+        if j - i >= privacy:
+            out.extend(segments[i:j])
+        i = j
+    return out
+
+
+class TileSink:
+    """Where finished tiles go: file dir, http(s) endpoint, or s3 bucket
+    (reference: AnonymisingProcessor.java:85-101,177-220)."""
+
+    def __init__(self, output: str):
+        self.output = output.rstrip("/")
+        self.is_bucket = self.output.endswith("amazonaws.com") or \
+            self.output.startswith("s3://")
+        self.is_http = self.output.startswith("http://") or \
+            self.output.startswith("https://")
+        if self.is_bucket and not (self.is_http or
+                                   self.output.startswith("s3://")):
+            raise ValueError(f"Cannot PUT to {output} without a scheme")
+        if not self.is_bucket and not self.is_http:
+            os.makedirs(self.output, exist_ok=True)
+
+    def store(self, tile_name: str, file_name: str, payload: str) -> bool:
+        try:
+            if self.is_bucket:
+                return self._store_s3(tile_name + "/" + file_name, payload)
+            if self.is_http:
+                req = urllib.request.Request(
+                    self.output + "/" + file_name, data=payload.encode(),
+                    method="POST",
+                    headers={"Content-Type": "text/plain;charset=utf-8"})
+                with urllib.request.urlopen(req, timeout=10):
+                    pass
+                return True
+            path = os.path.join(self.output, tile_name)
+            os.makedirs(path, exist_ok=True)
+            with open(os.path.join(path, file_name), "w") as f:
+                f.write(payload)
+            return True
+        except Exception as e:
+            logger.error("Couldn't flush tile to sink %s/%s: %s",
+                         tile_name, file_name, e)
+            return False
+
+    def _store_s3(self, key: str, payload: str) -> bool:
+        try:
+            import boto3  # gated: not present in all deployments
+        except ImportError:
+            logger.error("s3 output configured but boto3 unavailable")
+            return False
+        bucket = self.output.replace("s3://", "").split("/")[0] \
+            if self.output.startswith("s3://") else \
+            self.output.split("//")[1].split(".")[0]
+        boto3.client("s3").put_object(Bucket=bucket, Key=key,
+                                      Body=payload.encode())
+        return True
+
+
+class Anonymiser:
+    """Stateful slice store + flush loop."""
+
+    def __init__(self, sink: TileSink, privacy: int, quantisation: int,
+                 mode: str = "auto", source: str = "rtpu"):
+        if privacy < 1:
+            raise ValueError("Need a privacy parameter of 1 or more")
+        if quantisation < 60:
+            raise ValueError("Need quantisation parameter of 60 or more")
+        self.sink = sink
+        self.privacy = privacy
+        self.quantisation = quantisation
+        self.mode = mode.upper()
+        self.source = source
+        # tile -> current slice number; "tile.slice" -> segments
+        self.slice_of: Dict[TimeQuantisedTile, int] = {}
+        self.slices: Dict[str, List[Segment]] = {}
+
+    def process(self, key: str, segment: Segment) -> None:
+        for tile in TimeQuantisedTile.tiles_for(segment, self.quantisation):
+            slice_no = self.slice_of.get(tile)
+            if slice_no is None:
+                slice_no = 0
+                self.slice_of[tile] = 0
+            name = f"{tile}.{slice_no}"
+            bucket = self.slices.setdefault(name, [])
+            bucket.append(segment)
+            if len(bucket) >= SLICE_SIZE:
+                self.slice_of[tile] = slice_no + 1
+
+    def punctuate(self) -> int:
+        """Flush every tile: gather slices, sort, cull, store. Returns the
+        number of tiles written."""
+        written = 0
+        for tile, max_slice in list(self.slice_of.items()):
+            del self.slice_of[tile]
+            segments: List[Segment] = []
+            for i in range(max_slice + 1):
+                name = f"{tile}.{i}"
+                part = self.slices.pop(name, None)
+                if part is not None:
+                    segments.extend(part)
+                else:
+                    logger.warning("Missing quantised tile slice %s", name)
+            segments.sort(key=Segment.sort_key)
+            before = len(segments)
+            segments = privacy_cull(segments, self.privacy)
+            logger.info("Anonymised quantised tile %s from %d to %d segments",
+                        tile, before, len(segments))
+            if not segments:
+                continue
+            payload = "\n".join(
+                [Segment.column_layout()]
+                + [s.csv_row(self.mode, self.source) for s in segments])
+            tile_name = "{}_{}/{}/{}".format(
+                tile.time_range_start,
+                tile.time_range_start + self.quantisation - 1,
+                tile.tile_level(), tile.tile_index())
+            file_name = f"{self.source}.{uuidlib.uuid4()}"
+            logger.info("Writing tile to %s/%s/%s with %d segments",
+                        self.sink.output, tile_name, file_name, len(segments))
+            if self.sink.store(tile_name, file_name, payload):
+                written += 1
+        # drop unreferenced slices (reference: :258-265)
+        for name in list(self.slices):
+            logger.warning("Deleting unreferenced quantised tile slice %s",
+                           name)
+            del self.slices[name]
+        return written

@@ -1,0 +1,187 @@
+"""Per-uuid point batching and report triggering.
+
+The streaming analog of the reference's Batch + BatchingProcessor
+(reference: Batch.java, BatchingProcessor.java). Semantics preserved:
+
+- a per-uuid window accumulates points, tracking the max separation from
+  the *first* point by equirectangular distance (Batch.java:34-47)
+- a report fires once the window spans >= 500 m AND >= 10 points AND
+  >= 60 s (BatchingProcessor.java:26-28); on response, the consumed prefix
+  is trimmed at ``shape_used`` so match context overlaps windows
+  (Batch.java:73-80)
+- batches idle past the 60 s session gap are evicted with relaxed
+  thresholds (0 m, 2 points, 0 s) (BatchingProcessor.java:87-106)
+- valid (id, next_id) report pairs are forwarded keyed "id next_id"
+  (BatchingProcessor.java:108-141)
+- an unparseable matcher response drops the whole batch (Batch.java:83-87)
+
+What changed for the TPU: the matcher call is pluggable — an in-process
+``ReporterService.handle`` (which micro-batches across uuids on the device)
+instead of one HTTP POST per trace, though an HTTP submitter is provided
+for split deployments.
+"""
+from __future__ import annotations
+
+import json
+import logging
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..core.geo import equirectangular_m
+from ..core.osmlr import INVALID_SEGMENT_ID
+from ..core.types import Point, Segment
+
+logger = logging.getLogger("reporter_tpu.streaming")
+
+REPORT_TIME = 60       # seconds       (BatchingProcessor.java:26)
+REPORT_COUNT = 10      # points        (:27)
+REPORT_DIST = 500      # meters        (:28)
+SESSION_GAP_MS = 60000  # milliseconds (:29)
+
+
+class Batch:
+    __slots__ = ("max_separation", "last_update", "points")
+
+    def __init__(self, point: Optional[Point] = None):
+        self.max_separation = 0.0
+        self.last_update = 0
+        self.points: List[Point] = [point] if point is not None else []
+
+    def update(self, p: Point) -> None:
+        if self.points:
+            self.max_separation = max(
+                self.max_separation,
+                equirectangular_m(p.lat, p.lon,
+                                  self.points[0].lat, self.points[0].lon))
+        self.points.append(p)
+
+    def request_body(self, uuid: str, mode: str, report_on: str,
+                     transition_on: str) -> dict:
+        return {
+            "uuid": uuid,
+            "match_options": {
+                "mode": mode,
+                "report_levels": [int(x) for x in report_on.split(",")],
+                "transition_levels": [int(x) for x in transition_on.split(",")],
+            },
+            "trace": [p.to_json_obj() for p in self.points],
+        }
+
+    def report(self, uuid: str, submit: Callable[[dict], Optional[dict]],
+               mode: str, report_on: str, transition_on: str,
+               min_dist: float, min_size: int, min_elapsed: float
+               ) -> Optional[dict]:
+        """Fire a report if thresholds are met; trim consumed points."""
+        if self.max_separation < min_dist or len(self.points) < min_size or \
+                self.points[-1].time - self.points[0].time < min_elapsed:
+            return None
+        try:
+            response = submit(self.request_body(uuid, mode, report_on,
+                                                transition_on))
+        except Exception as e:
+            # a failed round trip drops the batch, like an unparseable
+            # response does in the reference
+            logger.error("Match submit failed for %s: %s", uuid, e)
+            self.max_separation = 0.0
+            self.points.clear()
+            return None
+        try:
+            trim_to = response.get("shape_used", len(self.points)) \
+                if response is not None else len(self.points)
+            del self.points[:trim_to]
+            self.max_separation = 0.0
+            first = self.points[0] if self.points else None
+            for p in self.points[1:]:
+                self.max_separation = max(
+                    self.max_separation,
+                    equirectangular_m(p.lat, p.lon, first.lat, first.lon))
+            return response
+        except Exception:
+            # unusable response: drop everything (reference: Batch.java:83-87)
+            self.max_separation = 0.0
+            self.points.clear()
+            return None
+
+
+def segments_from_response(response: Optional[dict]) -> List[Tuple[str, Segment]]:
+    """datastore.reports[] -> [(key, Segment)] with validity filtering
+    (reference: BatchingProcessor.java:108-141)."""
+    out: List[Tuple[str, Segment]] = []
+    if response is None:
+        return out
+    datastore = response.get("datastore")
+    reports = datastore.get("reports") if datastore else None
+    if reports is None:
+        if response:
+            logger.error("Unusable report %s", json.dumps(response)[:200])
+        return out
+    for entry in reports:
+        try:
+            seg = Segment(
+                id=int(entry["id"]),
+                next_id=(int(entry["next_id"])
+                         if entry.get("next_id") is not None else None),
+                min=float(entry["t0"]), max=float(entry["t1"]),
+                length=int(entry["length"]),
+                queue=int(entry["queue_length"]))
+        except Exception as e:
+            logger.error("Unusable reported segment pair: %s (%s)", entry, e)
+            continue
+        if seg.valid():
+            out.append((f"{seg.id} {seg.next_id}", seg))
+        else:
+            logger.warning("Got back invalid segment: %s", entry)
+    return out
+
+
+class PointBatcher:
+    """Stateful (uuid -> Batch) processor with eviction.
+
+    ``submit`` performs the match+report round trip and returns the parsed
+    response dict (or None). ``forward`` receives (key, Segment) pairs.
+    """
+
+    def __init__(self, submit: Callable[[dict], Optional[dict]],
+                 forward: Callable[[str, Segment], None],
+                 mode: str = "auto", report_on: str = "0,1",
+                 transition_on: str = "0,1",
+                 session_gap_ms: int = SESSION_GAP_MS):
+        self.submit = submit
+        self.forward = forward
+        self.mode = mode
+        self.report_on = report_on
+        self.transition_on = transition_on
+        self.session_gap_ms = session_gap_ms
+        self.store: Dict[str, Batch] = {}
+
+    def _forward_all(self, response: Optional[dict]) -> int:
+        n = 0
+        for key, seg in segments_from_response(response):
+            self.forward(key, seg)
+            n += 1
+        return n
+
+    def process(self, uuid: str, point: Point, stream_time_ms: int) -> None:
+        batch = self.store.pop(uuid, None)
+        if batch is None:
+            batch = Batch(point)
+        else:
+            batch.update(point)
+            response = batch.report(
+                uuid, self.submit, self.mode, self.report_on,
+                self.transition_on, REPORT_DIST, REPORT_COUNT, REPORT_TIME)
+            self._forward_all(response)
+        if batch.points:
+            batch.last_update = stream_time_ms
+            self.store[uuid] = batch
+
+    def punctuate(self, stream_time_ms: int) -> None:
+        """Evict batches idle past the session gap, reporting what we can
+        with relaxed thresholds (reference: BatchingProcessor.java:87-106)."""
+        for uuid in list(self.store):
+            batch = self.store[uuid]
+            if stream_time_ms - batch.last_update > self.session_gap_ms:
+                del self.store[uuid]
+                response = batch.report(
+                    uuid, self.submit, self.mode, self.report_on,
+                    self.transition_on, 0, 2, 0)
+                self._forward_all(response)

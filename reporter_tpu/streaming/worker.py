@@ -1,0 +1,204 @@
+"""The streaming worker: raw probe stream -> anonymised traffic tiles.
+
+Single-process topology mirroring the reference's Kafka Streams worker
+(reference: Reporter.java:21-39 topology diagram, :138-194 wiring):
+
+    raw -> [Formatter] -> (uuid, Point) -> [PointBatcher] -> (key, Segment)
+        -> [Anonymiser] -> tiles -> file / http / s3
+
+with the matcher reached either in-process (default — micro-batched onto
+the TPU via the service dispatcher) or over HTTP for split deployments
+(the reference's only mode, Batch.java:66-72).
+
+CLI options named after the reference's (Reporter.java:43-136):
+  --formatter/-f   one-string formatter config
+  --reporter-url/-u  http endpoint; omit for in-process matching
+  --mode/-m --reports/-r --transitions/-x
+  --privacy/-p --quantisation/-q --flush-interval/-i
+  --source/-s --output-location/-o --duration/-d
+plus --input (flat file / '-' for stdin replay) or --bootstrap/-b with
+--topics/-t for Kafka.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import sys
+import time
+import urllib.request
+from typing import Callable, Iterable, Optional
+
+from .anonymiser import Anonymiser, TileSink
+from .batcher import PointBatcher, SESSION_GAP_MS
+from .formatter import Formatter
+
+logger = logging.getLogger("reporter_tpu.streaming")
+
+HTTP_RETRIES = 3           # reference: HttpClient.java:80-88
+HTTP_TIMEOUT_S = 10.0
+
+
+def http_submitter(url: str) -> Callable[[dict], Optional[dict]]:
+    """POST the trace to a matcher service, with the reference's retry
+    policy; returns parsed JSON or None (reference: HttpClient.java:65-103).
+    """
+    def submit(trace: dict) -> Optional[dict]:
+        body = json.dumps(trace, separators=(",", ":")).encode()
+        for _ in range(HTTP_RETRIES):
+            try:
+                req = urllib.request.Request(
+                    url, data=body, method="POST",
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=HTTP_TIMEOUT_S) as r:
+                    return json.loads(r.read())
+            except Exception as e:
+                last = e
+        logger.error("POST %s failed after %d tries: %s",
+                     url, HTTP_RETRIES, last)
+        return None
+    return submit
+
+
+def inproc_submitter(service) -> Callable[[dict], Optional[dict]]:
+    """Use a ReporterService in this process — the TPU-native default."""
+    def submit(trace: dict) -> Optional[dict]:
+        code, body = service.handle(trace)
+        if code != 200:
+            logger.error("in-process match failed (%d): %s", code, body)
+            return None
+        return json.loads(body)
+    return submit
+
+
+class StreamWorker:
+    """Wires formatter -> batcher -> anonymiser and drives punctuation."""
+
+    def __init__(self, formatter: Formatter,
+                 submit: Callable[[dict], Optional[dict]],
+                 anonymiser: Anonymiser,
+                 mode: str = "auto", reports: str = "0,1",
+                 transitions: str = "0,1",
+                 flush_interval_s: float = 3600.0,
+                 session_gap_ms: int = SESSION_GAP_MS,
+                 clock=time.time):
+        self.formatter = formatter
+        self.anonymiser = anonymiser
+        self.batcher = PointBatcher(
+            submit, lambda key, seg: self.anonymiser.process(key, seg),
+            mode=mode, report_on=reports, transition_on=transitions,
+            session_gap_ms=session_gap_ms)
+        self.flush_interval_s = flush_interval_s
+        self.session_gap_ms = session_gap_ms
+        self.clock = clock
+        self.processed = 0
+        self.parse_failures = 0
+        self._last_flush = clock()
+        self._last_evict = clock()
+
+    def offer(self, message: str) -> None:
+        """One raw message through the topology."""
+        now_ms = int(self.clock() * 1000)
+        try:
+            uuid, point = self.formatter.format(message)
+        except Exception:
+            self.parse_failures += 1
+            if self.parse_failures % 1000 == 1:
+                logger.warning("Could not parse message: %r", message[:200])
+            return
+        self.batcher.process(uuid, point, now_ms)
+        self.processed += 1
+        if self.processed % 10000 == 0:
+            logger.info("Processed %d messages", self.processed)
+        self.maybe_punctuate()
+
+    def maybe_punctuate(self, force: bool = False) -> None:
+        now = self.clock()
+        if force or (now - self._last_evict) * 1000 >= 2 * self.session_gap_ms:
+            self.batcher.punctuate(int(now * 1000))
+            self._last_evict = now
+        if force or now - self._last_flush >= self.flush_interval_s:
+            self.anonymiser.punctuate()
+            self._last_flush = now
+
+    def drain(self) -> None:
+        """End of stream: evict every open batch and flush all tiles."""
+        self.batcher.punctuate(int(self.clock() * 1000) + 10 * self.session_gap_ms)
+        self.anonymiser.punctuate()
+
+    def run(self, messages: Iterable[str],
+            duration_s: Optional[float] = None) -> None:
+        deadline = self.clock() + duration_s if duration_s else None
+        for message in messages:
+            self.offer(message)
+            if deadline is not None and self.clock() > deadline:
+                break
+        self.drain()
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="reporter-stream",
+        description="TPU-native streaming reporter worker")
+    parser.add_argument("-f", "--formatter", required=True,
+                        help="one-string formatter config (see README)")
+    parser.add_argument("-u", "--reporter-url",
+                        help="matcher service URL; omit to match in-process")
+    parser.add_argument("--graph",
+                        help="RoadNetwork .npz for in-process matching")
+    parser.add_argument("-m", "--mode", default="auto")
+    parser.add_argument("-r", "--reports", default="0,1")
+    parser.add_argument("-x", "--transitions", default="0,1")
+    parser.add_argument("-p", "--privacy", type=int, required=True)
+    parser.add_argument("-q", "--quantisation", type=int, required=True)
+    parser.add_argument("-i", "--flush-interval", type=int, required=True)
+    parser.add_argument("-s", "--source", required=True)
+    parser.add_argument("-o", "--output-location", required=True)
+    parser.add_argument("-d", "--duration", type=int)
+    parser.add_argument("--input", default="-",
+                        help="flat file to replay, '-' for stdin")
+    parser.add_argument("-b", "--bootstrap", help="Kafka bootstrap servers")
+    parser.add_argument("-t", "--topics",
+                        help="comma-separated topics; first is raw input")
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)s %(levelname)s %(message)s")
+
+    if args.reporter_url:
+        submit = http_submitter(args.reporter_url)
+    else:
+        from ..graph.network import RoadNetwork
+        from ..matcher import SegmentMatcher
+        from ..service.server import ReporterService
+        if not args.graph:
+            parser.error("--graph is required for in-process matching")
+        service = ReporterService(
+            SegmentMatcher(net=RoadNetwork.load(args.graph)))
+        submit = inproc_submitter(service)
+
+    worker = StreamWorker(
+        Formatter.from_config(args.formatter), submit,
+        Anonymiser(TileSink(args.output_location), args.privacy,
+                   args.quantisation, mode=args.mode, source=args.source),
+        mode=args.mode, reports=args.reports, transitions=args.transitions,
+        flush_interval_s=args.flush_interval)
+
+    if args.bootstrap:
+        from .broker import KafkaBroker
+        broker = KafkaBroker(args.bootstrap)
+        raw_topic = (args.topics or "raw").split(",")[0]
+        messages = (value.decode() for _key, value in broker.consume(raw_topic))
+    elif args.input == "-":
+        messages = (line for line in sys.stdin)
+    else:
+        messages = open(args.input)
+
+    worker.run(messages, duration_s=args.duration)
+    logger.info("Done: %d processed, %d parse failures",
+                worker.processed, worker.parse_failures)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

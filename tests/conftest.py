@@ -1,9 +1,33 @@
 """Test configuration: run JAX on a virtual 8-device CPU mesh so sharding
-paths are exercised without TPU hardware. Must run before jax imports."""
+paths are exercised without TPU hardware.
+
+Two hazards specific to this environment:
+- JAX_PLATFORMS is pre-set to the single real TPU chip's platform; tests
+  must never contend for it (bench.py owns the chip), so force cpu.
+- sitecustomize registers the TPU PJRT plugin in every interpreter before
+  conftest runs; merely setting JAX_PLATFORMS=cpu still initialises that
+  backend (and blocks on the chip tunnel), so the factory is removed from
+  the registry outright.
+"""
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+from jax._src import xla_bridge
+
+# jax was already imported by sitecustomize, so the env var change above
+# came too late for its config — update it directly as well
+jax.config.update("jax_platforms", "cpu")
+for _name in list(xla_bridge._backend_factories):
+    if _name != "cpu":
+        xla_bridge._backend_factories.pop(_name, None)
+
+# fail loudly if the force-to-CPU mechanism ever stops working; tests must
+# never contend for the single real TPU chip (bench.py owns it)
+assert jax.default_backend() == "cpu", (
+    "tests must run on the CPU backend, got " + jax.default_backend())

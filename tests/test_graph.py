@@ -1,0 +1,132 @@
+import numpy as np
+import pytest
+
+from reporter_tpu.graph import RoadNetwork, SpatialGrid, candidate_route_matrices
+from reporter_tpu.graph.route import RouteCache, route_distance, shortest_path_edges, UNREACHABLE
+from reporter_tpu.graph.spatial import PAD_EDGE
+from reporter_tpu.synth import build_grid_city, generate_trace
+
+
+@pytest.fixture(scope="module")
+def city():
+    return build_grid_city(rows=10, cols=10, spacing_m=200.0, seed=1)
+
+
+class TestNetwork:
+    def test_shapes(self, city):
+        assert city.num_nodes == 100
+        # every run direction covered: 2*(rows*(cols-1) + cols*(rows-1)) edges
+        assert city.num_edges == 2 * (10 * 9 + 10 * 9)
+        offsets, edges = city.csr()
+        assert offsets[-1] == city.num_edges
+        assert len(edges) == city.num_edges
+
+    def test_csr_consistent(self, city):
+        offsets, edges = city.csr()
+        for node in (0, 37, 99):
+            out = edges[offsets[node]:offsets[node + 1]]
+            assert all(city.edge_start[e] == node for e in out)
+
+    def test_segments_have_lengths(self, city):
+        associated = city.edge_segment_id[city.edge_segment_id >= 0]
+        assert len(associated) > 0
+        for sid in np.unique(associated):
+            assert city.segment_length_m[int(sid)] > 0
+
+    def test_save_load_roundtrip(self, city, tmp_path):
+        p = str(tmp_path / "city.npz")
+        city.save(p)
+        loaded = RoadNetwork.load(p)
+        np.testing.assert_array_equal(loaded.edge_segment_id, city.edge_segment_id)
+        np.testing.assert_allclose(loaded.node_lat, city.node_lat)
+        assert loaded.segment_length_m == city.segment_length_m
+
+
+class TestSpatial:
+    def test_candidates_find_true_edge(self, city):
+        grid = SpatialGrid(city)
+        # a point 5m off the midpoint of edge 0
+        nx, ny = city.node_xy()
+        e = 0
+        mx = (nx[city.edge_start[e]] + nx[city.edge_end[e]]) / 2
+        my = (ny[city.edge_start[e]] + ny[city.edge_end[e]]) / 2 + 5.0
+        _, to_ll = city.projection()
+        lat, lon = to_ll(mx, my)
+        cands = grid.candidates(np.array([lat]), np.array([lon]), k=4)
+        assert e in cands.edge_ids[0]
+        slot = list(cands.edge_ids[0]).index(e)
+        assert cands.dist_m[0, slot] == pytest.approx(5.0, abs=0.5)
+        assert cands.offset_m[0, slot] == pytest.approx(100.0, abs=2.0)
+
+    def test_padding_when_far_away(self, city):
+        grid = SpatialGrid(city)
+        lat0 = float(city.node_lat.mean()) + 1.0  # ~111 km north
+        cands = grid.candidates(np.array([lat0]), np.array([120.98]), k=4)
+        assert (cands.edge_ids[0] == PAD_EDGE).all()
+
+
+class TestRoute:
+    def test_same_edge_forward(self, city):
+        d = route_distance(city, 3, 10.0, 3, 150.0, max_dist=1000.0)
+        assert d == pytest.approx(140.0)
+
+    def test_adjacent_edges(self, city):
+        # follow edge 0 into an out-edge of its end node
+        offsets, edges = city.csr()
+        end = int(city.edge_end[0])
+        nxt = int(edges[offsets[end]])
+        d = route_distance(city, 0, 50.0, nxt, 30.0, max_dist=1000.0)
+        assert d == pytest.approx((200.0 - 50.0) + 30.0)
+
+    def test_unreachable_when_bounded(self, city):
+        # far corner beyond a tiny bound
+        d = route_distance(city, 0, 0.0, city.num_edges - 1, 0.0, max_dist=100.0)
+        assert d == UNREACHABLE
+
+    def test_shortest_path_edges_connects(self, city):
+        path = shortest_path_edges(city, 0, 99)
+        assert path is not None
+        assert int(city.edge_start[path[0]]) == 0
+        assert int(city.edge_end[path[-1]]) == 99
+        for a, b in zip(path[:-1], path[1:]):
+            assert city.edge_end[a] == city.edge_start[b]
+
+    def test_cache_hits(self, city):
+        cache = RouteCache(city)
+        route_distance(city, 0, 0.0, 5, 10.0, 5000.0, cache)
+        before = cache.misses
+        route_distance(city, 0, 0.0, 5, 20.0, 5000.0, cache)
+        assert cache.misses == before and cache.hits >= 1
+
+
+class TestSynthTrace:
+    def test_generate(self, city):
+        rng = np.random.default_rng(7)
+        tr = None
+        while tr is None:
+            tr = generate_trace(city, "veh-1", rng, noise_m=4.0)
+        assert len(tr.points) >= 2
+        assert all(p2["time"] > p1["time"] for p1, p2 in zip(tr.points, tr.points[1:]))
+        req = tr.request_json()
+        assert req["uuid"] == "veh-1"
+        assert set(req["match_options"]) == {"mode", "report_levels", "transition_levels"}
+        truth = tr.truth_segments(city)
+        assert len(truth) >= 1
+
+    def test_route_matrix_includes_truth_transition(self, city):
+        rng = np.random.default_rng(3)
+        tr = None
+        while tr is None:
+            tr = generate_trace(city, "veh-2", rng, noise_m=3.0)
+        grid = SpatialGrid(city)
+        lat = np.array([p["lat"] for p in tr.points])
+        lon = np.array([p["lon"] for p in tr.points])
+        cands = grid.candidates(lat, lon, k=4)
+        from reporter_tpu.core.geo import equirectangular_m
+        gc = equirectangular_m(lat[:-1], lon[:-1], lat[1:], lon[1:])
+        mats = candidate_route_matrices(city, cands, gc)
+        assert mats.shape == (len(tr.points) - 1, 4, 4)
+        # at least some transitions should be routable and short
+        finite = mats[mats < UNREACHABLE]
+        assert finite.size > 0
+        assert finite.min() < 100.0

@@ -1,0 +1,202 @@
+import json
+
+import numpy as np
+import pytest
+
+from reporter_tpu.graph.spatial import SpatialGrid
+from reporter_tpu.matcher import MatchParams, SegmentMatcher
+from reporter_tpu.matcher.batchpad import (
+    LENGTH_BUCKETS, _select_kept, bucket_length, pack_batches, prepare_trace)
+from reporter_tpu.matcher.hmm import (
+    NORMAL, RESTART, SKIP, viterbi_decode_batch)
+from reporter_tpu.synth import build_grid_city, generate_trace
+
+
+@pytest.fixture(scope="module")
+def city():
+    # no service roads / internals for the core accuracy tests
+    return build_grid_city(rows=12, cols=12, spacing_m=200.0, seed=2,
+                           service_road_fraction=0.0, internal_fraction=0.0)
+
+
+@pytest.fixture(scope="module")
+def matcher(city):
+    return SegmentMatcher(net=city)
+
+
+def make_trace(city, seed, noise=4.0, **kw):
+    rng = np.random.default_rng(seed)
+    for _ in range(500):
+        tr = generate_trace(city, f"veh-{seed}", rng, noise_m=noise, **kw)
+        if tr is not None:
+            return tr
+    raise RuntimeError("could not generate a trace with the given constraints")
+
+
+class TestPointFiltering:
+    def test_jitter_points_excluded(self):
+        # three points: 2nd within 10m of the 1st -> excluded
+        lat = np.array([14.6, 14.60001, 14.6010])
+        lon = np.array([121.0, 121.0, 121.0])
+        kept = _select_kept(lat, lon, [True, True, True], 10.0)
+        assert kept.tolist() == [0, 2]
+
+    def test_candidateless_points_excluded(self):
+        lat = np.array([14.6, 14.601, 14.602])
+        lon = np.array([121.0, 121.0, 121.0])
+        kept = _select_kept(lat, lon, [True, False, True], 10.0)
+        assert kept.tolist() == [0, 2]
+
+    def test_case_codes_from_prepare(self, city, matcher):
+        tr = make_trace(city, seed=61)
+        p = prepare_trace(city, matcher.grid, tr.points, MatchParams(),
+                          matcher.route_cache)
+        assert p.case[0] == RESTART
+        assert all(c == NORMAL for c in p.case[1:p.num_kept])
+        assert all(c == SKIP for c in p.case[p.num_kept:])
+
+
+class TestBuckets:
+    def test_bucket_length(self):
+        assert bucket_length(2) == 16
+        assert bucket_length(16) == 16
+        assert bucket_length(17) == 64
+        assert bucket_length(5000) == LENGTH_BUCKETS[-1]
+
+
+class TestViterbi:
+    def test_prefers_low_emission_with_consistent_transitions(self):
+        # 3 points, 2 candidates: candidate 0 always near, transitions
+        # consistent; candidate 1 far. Viterbi must pick 0 throughout.
+        B, T, K = 1, 3, 2
+        dist = np.array([[[2.0, 40.0], [2.0, 40.0], [2.0, 40.0]]], np.float32)
+        valid = np.ones((B, T, K), bool)
+        gc = np.full((B, T - 1), 30.0, np.float32)
+        route = np.full((B, T - 1, K, K), 30.0, np.float32)
+        case = np.array([[RESTART, NORMAL, NORMAL]], np.int32)
+        paths, scores = viterbi_decode_batch(
+            dist, valid, route, gc, case, np.float32(4.07), np.float32(3.0))
+        assert paths.tolist() == [[0, 0, 0]]
+        assert float(scores[0]) > -10.0
+
+    def test_transition_overrides_emission(self):
+        # candidate 1 slightly farther but the only one with a consistent
+        # route; candidate 0 near but unroutable from itself.
+        B, T, K = 1, 2, 2
+        dist = np.array([[[2.0, 6.0], [2.0, 6.0]]], np.float32)
+        valid = np.ones((B, T, K), bool)
+        gc = np.full((B, 1), 30.0, np.float32)
+        route = np.full((B, 1, K, K), 1.0e9, np.float32)  # all unreachable...
+        route[0, 0, 1, 1] = 30.0                          # ...except 1->1
+        case = np.array([[RESTART, NORMAL]], np.int32)
+        paths, _ = viterbi_decode_batch(
+            dist, valid, route, gc, case, np.float32(4.07), np.float32(3.0))
+        assert paths.tolist() == [[1, 1]]
+
+    def test_restart_decodes_both_chains(self):
+        # two chains: best candidate differs across the break
+        B, T, K = 1, 4, 2
+        dist = np.array([[[1.0, 50.0], [1.0, 50.0],
+                          [50.0, 1.0], [50.0, 1.0]]], np.float32)
+        valid = np.ones((B, T, K), bool)
+        gc = np.full((B, T - 1), 20.0, np.float32)
+        route = np.full((B, T - 1, K, K), 20.0, np.float32)
+        case = np.array([[RESTART, NORMAL, RESTART, NORMAL]], np.int32)
+        paths, _ = viterbi_decode_batch(
+            dist, valid, route, gc, case, np.float32(4.07), np.float32(3.0))
+        assert paths.tolist() == [[0, 0, 1, 1]]
+
+
+class TestEndToEndMatch:
+    def test_decoded_edges_match_truth(self, city, matcher):
+        tr = make_trace(city, seed=11, noise=3.0)
+        match = matcher.match_many([tr.request_json()])[0]
+        assert match["mode"] == "auto"
+        got = [s["segment_id"] for s in match["segments"] if "segment_id" in s]
+        truth = tr.truth_segments(city)
+        # every truth segment observed long enough should be found, in order
+        common = [s for s in got if s in truth]
+        assert len(common) >= max(1, len(truth) - 2)
+        # order preserved
+        idx = [truth.index(s) for s in dict.fromkeys(common)]
+        assert idx == sorted(idx)
+
+    def test_segment_accuracy_over_many_traces(self, city, matcher):
+        """Point-level segment agreement with ground truth >= 97%."""
+        agree = total = 0
+        reqs, truths = [], []
+        for seed in range(20):
+            tr = make_trace(city, seed=100 + seed, noise=4.0)
+            reqs.append(tr.request_json())
+            truths.append(tr)
+        matches = matcher.match_many(reqs)
+        for match, tr in zip(matches, truths):
+            truth_point_segs = [
+                int(city.edge_segment_id[e]) for e in tr.point_edges]
+            # decoded per-point segment via begin/end shape indices
+            decoded = {}
+            for s in match["segments"]:
+                sid = s.get("segment_id")
+                for i in range(s["begin_shape_index"], s["end_shape_index"] + 1):
+                    decoded[i] = sid
+            for i, true_sid in enumerate(truth_point_segs):
+                if true_sid < 0:
+                    continue
+                total += 1
+                if decoded.get(i) == true_sid:
+                    agree += 1
+        assert total > 100
+        assert agree / total >= 0.97, f"accuracy {agree}/{total}"
+
+    def test_match_json_roundtrip(self, city, matcher):
+        tr = make_trace(city, seed=21)
+        out = matcher.Match(json.dumps(tr.request_json()))
+        match = json.loads(out)
+        assert "segments" in match and "mode" in match
+        seg = next(s for s in match["segments"] if "segment_id" in s)
+        for key in ("start_time", "end_time", "length", "queue_length",
+                    "internal", "begin_shape_index", "end_shape_index",
+                    "way_ids"):
+            assert key in seg
+
+    def test_complete_segments_have_plausible_times(self, city, matcher):
+        tr = make_trace(city, seed=31, noise=2.0)
+        match = matcher.match_many([tr.request_json()])[0]
+        complete = [s for s in match["segments"]
+                    if s.get("segment_id") and s["length"] > 0]
+        assert complete, "expected at least one completely-traversed segment"
+        for s in complete:
+            dt = s["end_time"] - s["start_time"]
+            assert dt > 0
+            speed_kph = s["length"] / dt * 3.6
+            assert 10.0 < speed_kph < 120.0
+
+    def test_partial_end_segment_flagged(self, city, matcher):
+        tr = make_trace(city, seed=41, noise=2.0)
+        match = matcher.match_many([tr.request_json()])[0]
+        segs = [s for s in match["segments"] if "segment_id" in s]
+        # the trace almost surely ends mid-segment
+        last = segs[-1]
+        if last["end_time"] == -1:
+            assert last["length"] == -1
+
+
+class TestBatching:
+    def test_mixed_lengths_pack_into_buckets(self, city, matcher):
+        reqs = []
+        for seed in (51, 52, 53):
+            tr = make_trace(city, seed=seed, max_route_edges=10)
+            reqs.append(tr.request_json())
+        long_tr = make_trace(city, seed=54, min_route_edges=16,
+                             max_route_edges=22)
+        reqs.append(long_tr.request_json())
+        prepared = [prepare_trace(city, matcher.grid, r["trace"],
+                                  MatchParams(), matcher.route_cache)
+                    for r in reqs]
+        batches = pack_batches(prepared)
+        assert {b.dist_m.shape[1] for b in batches} <= set(LENGTH_BUCKETS)
+        assert sum(len(b.traces) for b in batches) == 4
+        # results come back for every trace regardless of bucket
+        matches = matcher.match_many(reqs)
+        assert len(matches) == 4
+        assert all(m["segments"] for m in matches)

@@ -1,0 +1,110 @@
+"""Batch pipeline tests: gather -> batched match -> tile report, end to end
+over local files (the reference's S3 path is gated off in this image)."""
+import gzip
+import os
+
+import numpy as np
+import pytest
+
+from reporter_tpu.core.types import Segment
+from reporter_tpu.matcher import SegmentMatcher
+from reporter_tpu.pipeline.simple_reporter import (
+    _windows_of, gather_traces, match_traces, report_tiles)
+from reporter_tpu.synth import build_grid_city, generate_trace
+
+
+@pytest.fixture(scope="module")
+def city():
+    return build_grid_city(rows=10, cols=10, spacing_m=200.0, seed=4,
+                           service_road_fraction=0.0, internal_fraction=0.0)
+
+
+def make_part_file(city, path, n_traces=5, seed=0):
+    """Pipe-separated part file shaped like the reference's default valuer
+    expects: col1=uuid, col0=time, col9=lat, col10=lon, col5=accuracy."""
+    rng = np.random.default_rng(seed)
+    lines = []
+    for i in range(n_traces):
+        tr = None
+        while tr is None:
+            tr = generate_trace(city, f"veh-{seed}-{i}", rng, noise_m=3.0,
+                                min_route_edges=8)
+        for p in tr.points:
+            cols = ["x"] * 11
+            cols[0] = str(p["time"])
+            cols[1] = tr.uuid
+            cols[5] = str(p["accuracy"])
+            cols[9] = str(p["lat"])
+            cols[10] = str(p["lon"])
+            lines.append("|".join(cols))
+    with gzip.open(path, "wt") as f:
+        f.write("\n".join(lines))
+    return lines
+
+
+class TestWindows:
+    def test_split_at_inactivity(self):
+        pts = [{"time": t} for t in (0, 10, 20, 300, 310, 320)]
+        wins = list(_windows_of(pts, inactivity=120))
+        assert [len(w) for w in wins] == [3, 3]
+
+    def test_short_windows_dropped(self):
+        pts = [{"time": t} for t in (0, 300, 310)]
+        wins = list(_windows_of(pts, inactivity=120))
+        assert [len(w) for w in wins] == [2]
+
+
+class TestPipelineEndToEnd:
+    def test_three_stages(self, city, tmp_path, monkeypatch):
+        monkeypatch.chdir(tmp_path)
+        src_dir = tmp_path / "src"
+        src_dir.mkdir()
+        make_part_file(city, str(src_dir / "part-000.gz"), n_traces=4, seed=1)
+        make_part_file(city, str(src_dir / "part-001.gz"), n_traces=4, seed=2)
+
+        trace_dir = gather_traces(str(src_dir), ".*",
+                                  "lambda l: (lambda c: [c[1], c[0], c[9], "
+                                  "c[10], c[5]])(l.split('|'))",
+                                  "%Y-%m-%d %H:%M:%S",
+                                  [-90.0, -180.0, 90.0, 180.0], concurrency=2)
+        shard_files = [os.path.join(r, f)
+                       for r, _d, fs in os.walk(trace_dir) for f in fs]
+        assert shard_files, "stage 1 produced no shards"
+
+        matcher = SegmentMatcher(net=city)
+        match_dir = match_traces(
+            trace_dir, matcher, "auto", {0, 1, 2}, {0, 1, 2},
+            quantisation=3600, inactivity=120, source="test")
+        tile_files = [os.path.join(r, f)
+                      for r, _d, fs in os.walk(match_dir) for f in fs]
+        assert tile_files, "stage 2 produced no tile rows"
+        # rows have the 10-column layout with uppercased mode
+        with open(tile_files[0]) as f:
+            cols = f.readline().strip().split(",")
+        assert len(cols) == 10 and cols[9] == "AUTO" and cols[3] == "1"
+
+        dest = tmp_path / "dest"
+        report_tiles(match_dir, str(dest), privacy=1, concurrency=2)
+        out_files = [os.path.join(r, f)
+                     for r, _d, fs in os.walk(dest) for f in fs]
+        assert out_files, "stage 3 wrote nothing"
+        with open(out_files[0]) as f:
+            assert f.readline().strip() == Segment.column_layout()
+
+    def test_privacy_cull_removes_rare_pairs(self, city, tmp_path):
+        match_dir = tmp_path / "matches" / "0_3599" / "0"
+        match_dir.mkdir(parents=True)
+        rows = (["5,6,10,1,600,0,0,10,src,AUTO\n"] * 3
+                + ["7,8,10,1,600,0,0,10,src,AUTO\n"])
+        with open(match_dir / "42", "w") as f:
+            f.writelines(rows)
+        dest = tmp_path / "out"
+        report_tiles(str(tmp_path / "matches"), str(dest), privacy=2,
+                     concurrency=1)
+        out_files = [os.path.join(r, f)
+                     for r, _d, fs in os.walk(dest) for f in fs]
+        (path,) = out_files
+        with open(path) as f:
+            body = f.read()
+        assert body.count("5,6,") == 3
+        assert "7,8," not in body

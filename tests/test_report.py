@@ -1,0 +1,183 @@
+"""Tests for report() semantics (reference: py/reporter_service.py:79-179)."""
+import math
+
+import pytest
+
+from reporter_tpu.service.report import report
+
+
+def seg(segment_id=None, start=0.0, end=10.0, length=600, queue=0,
+        internal=False, begin=0, endi=5):
+    s = {
+        "start_time": start, "end_time": end, "length": length,
+        "queue_length": queue, "internal": internal,
+        "begin_shape_index": begin, "end_shape_index": endi,
+        "way_ids": [],
+    }
+    if segment_id is not None:
+        s["segment_id"] = segment_id
+    return s
+
+
+def trace_ending_at(t):
+    return {"uuid": "x", "trace": [{"lat": 0, "lon": 0, "time": 0},
+                                   {"lat": 0, "lon": 0, "time": t}]}
+
+
+LV0_A = 0x100 << 3 | 0   # level 0 ids
+LV0_B = 0x200 << 3 | 0
+LV0_C = 0x300 << 3 | 0
+LV2_A = 0x100 << 3 | 2   # level 2 id
+
+
+class TestPairEmission:
+    def test_basic_pair(self):
+        match = {"segments": [
+            seg(LV0_A, 0, 30, begin=0),
+            seg(LV0_B, 30, 60, begin=5),
+        ]}
+        out = report(match, trace_ending_at(100), 15, {0, 1}, {0, 1})
+        reports = out["datastore"]["reports"]
+        assert len(reports) == 1
+        r = reports[0]
+        assert r["id"] == LV0_A
+        assert r["next_id"] == LV0_B
+        # t1 = successor's start since its level is in transition_levels
+        assert r["t0"] == 0 and r["t1"] == 30
+        assert out["datastore"]["mode"] == "auto"
+
+    def test_t1_is_own_end_when_successor_level_not_transitional(self):
+        match = {"segments": [
+            seg(LV0_A, 0, 28),
+            seg(LV2_A, 30, 60),
+        ]}
+        out = report(match, trace_ending_at(100), 15, {0, 1}, {0, 1})
+        (r,) = out["datastore"]["reports"]
+        assert r["t1"] == 28          # own end_time, not successor start
+        assert "next_id" not in r
+
+    def test_last_segment_not_reported_without_successor(self):
+        match = {"segments": [seg(LV0_A, 0, 30)]}
+        out = report(match, trace_ending_at(100), 15, {0, 1}, {0, 1})
+        assert out["datastore"]["reports"] == []
+
+    def test_level_not_reported_counts_unreported(self):
+        match = {"segments": [
+            seg(LV2_A, 0, 30),
+            seg(LV0_B, 30, 60),
+        ]}
+        out = report(match, trace_ending_at(100), 15, {0, 1}, {0, 1, 2})
+        assert out["datastore"]["reports"] == []
+        assert out["stats"]["unreported_matches"]["count"] == 1
+
+
+class TestHoldback:
+    def test_trailing_segments_withheld(self):
+        # trace ends at t=100; segment starting at 90 is within 15s holdback
+        match = {"segments": [
+            seg(LV0_A, 0, 50, begin=0),
+            seg(LV0_B, 50, 90, begin=4),
+            seg(LV0_C, 90, 100, begin=8),
+        ]}
+        out = report(match, trace_ending_at(100), 15, {0, 1}, {0, 1})
+        ids = [r["id"] for r in out["datastore"]["reports"]]
+        assert ids == [LV0_A]
+        assert out["shape_used"] == 4  # begin_shape_index of LV0_B
+
+    def test_shape_used_omitted_when_zero(self):
+        # reference quirk: `if shape_used:` drops index 0
+        match = {"segments": [
+            seg(LV0_A, 0, 50, begin=0),
+            seg(LV0_B, 50, 80, begin=0),
+        ]}
+        out = report(match, trace_ending_at(100), 15, {0, 1}, {0, 1})
+        assert "shape_used" not in out
+
+    def test_all_segments_recent_no_reports(self):
+        match = {"segments": [
+            seg(LV0_A, 95, 97), seg(LV0_B, 97, 99),
+        ]}
+        out = report(match, trace_ending_at(100), 15, {0, 1}, {0, 1})
+        assert out["datastore"]["reports"] == []
+        assert "shape_used" not in out
+
+
+class TestValidity:
+    def test_nonpositive_dt_counts_invalid_time(self):
+        match = {"segments": [
+            seg(LV0_A, 30, 30),  # zero duration with t1=successor start=30
+            seg(LV0_B, 30, 60),
+        ]}
+        out = report(match, trace_ending_at(100), 15, {0, 1}, {0, 1})
+        assert out["datastore"]["reports"] == []
+        assert out["stats"]["match_errors"]["invalid_times"] == 1
+
+    def test_overspeed_counts_invalid_speed(self):
+        # 600m in 2s = 1080 km/h
+        match = {"segments": [
+            seg(LV0_A, 0, 2, length=600),
+            seg(LV0_B, 2, 60),
+        ]}
+        out = report(match, trace_ending_at(100), 15, {0, 1}, {0, 1})
+        assert out["datastore"]["reports"] == []
+        assert out["stats"]["match_errors"]["invalid_speeds"] == 1
+
+    def test_partial_length_not_reported(self):
+        match = {"segments": [
+            seg(LV0_A, -1, 30, length=-1),
+            seg(LV0_B, 30, 60),
+        ]}
+        out = report(match, trace_ending_at(100), 15, {0, 1}, {0, 1})
+        assert out["datastore"]["reports"] == []
+
+
+class TestInternalBridging:
+    def test_internal_bridges_pair(self):
+        match = {"segments": [
+            seg(LV0_A, 0, 30),
+            seg(None, 30, 32, length=-1, internal=True),
+            seg(LV0_B, 32, 60),
+        ]}
+        out = report(match, trace_ending_at(100), 15, {0, 1}, {0, 1})
+        (r,) = out["datastore"]["reports"]
+        assert r["id"] == LV0_A and r["next_id"] == LV0_B
+        assert r["t1"] == 32  # successor (LV0_B) start
+        # internal does not count as unassociated
+        assert out["stats"]["unassociated_segments"] == 0
+
+
+class TestStats:
+    def test_discontinuity_counted(self):
+        match = {"segments": [
+            seg(LV0_A, 0, -1),
+            seg(LV0_B, -1, 60, length=-1),
+            seg(LV0_C, 60, 80),
+        ]}
+        out = report(match, trace_ending_at(100), 15, {0, 1}, {0, 1})
+        assert out["stats"]["match_errors"]["discontinuities"] == 1
+
+    def test_unassociated_counted(self):
+        match = {"segments": [
+            seg(LV0_A, 0, 30),
+            seg(None, 30, 40, length=-1, internal=False),
+            seg(LV0_B, 40, 60),
+        ]}
+        out = report(match, trace_ending_at(100), 15, {0, 1}, {0, 1})
+        assert out["stats"]["unassociated_segments"] == 1
+
+    def test_successful_stats_accumulate(self):
+        match = {"segments": [
+            seg(LV0_A, 0, 20, length=500),
+            seg(LV0_B, 20, 40, length=700),
+            seg(LV0_C, 40, 60),
+        ]}
+        out = report(match, trace_ending_at(100), 15, {0, 1}, {0, 1})
+        s = out["stats"]["successful_matches"]
+        assert s["count"] == 2
+        assert s["length"] == pytest.approx(1.2)
+
+    def test_segment_matcher_echoed(self):
+        match = {"segments": [seg(LV0_A, 0, 30), seg(LV0_B, 30, 60)]}
+        out = report(match, trace_ending_at(100), 15, {0, 1}, {0, 1})
+        assert out["segment_matcher"] is match
+        assert out["segment_matcher"]["mode"] == "auto"

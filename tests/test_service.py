@@ -1,0 +1,107 @@
+"""End-to-end /report service tests over the synthetic city."""
+import concurrent.futures
+import json
+import socket
+import urllib.parse
+import urllib.request
+
+import numpy as np
+import pytest
+
+from reporter_tpu.matcher import SegmentMatcher
+from reporter_tpu.service.server import ReporterService, serve
+from reporter_tpu.synth import build_grid_city, generate_trace
+
+
+@pytest.fixture(scope="module")
+def city():
+    return build_grid_city(rows=10, cols=10, spacing_m=200.0, seed=3,
+                           service_road_fraction=0.0, internal_fraction=0.0)
+
+
+@pytest.fixture(scope="module")
+def server(city):
+    service = ReporterService(SegmentMatcher(net=city), threshold_sec=15,
+                              max_batch=64, max_wait_ms=30.0)
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    httpd = serve(service, "127.0.0.1", port)
+    yield f"http://127.0.0.1:{port}"
+    httpd.shutdown()
+
+
+def make_req(city, seed):
+    rng = np.random.default_rng(seed)
+    tr = None
+    while tr is None:
+        tr = generate_trace(city, f"veh-{seed}", rng, noise_m=3.0)
+    return tr.request_json()
+
+
+def get(url):
+    with urllib.request.urlopen(url) as r:
+        return r.status, json.loads(r.read())
+
+
+def post(url, body):
+    req = urllib.request.Request(url, data=json.dumps(body).encode(),
+                                 method="POST")
+    try:
+        with urllib.request.urlopen(req) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+class TestService:
+    def test_get_report(self, city, server):
+        req = make_req(city, 1)
+        q = urllib.parse.urlencode({"json": json.dumps(req)})
+        status, body = get(f"{server}/report?{q}")
+        assert status == 200
+        assert body["datastore"]["mode"] == "auto"
+        assert "segments" in body["segment_matcher"]
+        assert "stats" in body
+
+    def test_post_report(self, city, server):
+        status, body = post(f"{server}/report", make_req(city, 2))
+        assert status == 200
+        assert isinstance(body["datastore"]["reports"], list)
+
+    def test_missing_uuid_400(self, city, server):
+        req = make_req(city, 3)
+        del req["uuid"]
+        status, body = post(f"{server}/report", req)
+        assert status == 400
+        assert body["error"] == "uuid is required"
+
+    def test_single_point_400(self, city, server):
+        req = make_req(city, 4)
+        req["trace"] = req["trace"][:1]
+        status, body = post(f"{server}/report", req)
+        assert status == 400
+        assert "non zero length" in body["error"]
+
+    def test_missing_levels_400(self, city, server):
+        req = make_req(city, 5)
+        del req["match_options"]["report_levels"]
+        status, body = post(f"{server}/report", req)
+        assert status == 400
+        assert "report_levels" in body["error"]
+
+    def test_bad_action_400(self, server):
+        status, body = post(f"{server}/nonsense", {"uuid": "x"})
+        assert status == 400
+        assert "valid action" in body["error"]
+
+    def test_concurrent_requests_batched(self, city, server):
+        reqs = [make_req(city, 100 + i) for i in range(16)]
+        with concurrent.futures.ThreadPoolExecutor(16) as pool:
+            results = list(pool.map(
+                lambda r: post(f"{server}/report", r), reqs))
+        assert all(status == 200 for status, _ in results)
+        # every response carries that trace's own uuid-independent result;
+        # sanity: each has a stats block and parseable reports
+        for _, body in results:
+            assert "stats" in body and "datastore" in body

@@ -1,0 +1,166 @@
+"""Streaming worker tests: formatter parity, batching thresholds, privacy
+culling, and an end-to-end replay -> tile files (the in-process analog of
+the reference's tests/circle.sh integration test)."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+from reporter_tpu.core.types import Point, Segment
+from reporter_tpu.matcher import SegmentMatcher
+from reporter_tpu.service.server import ReporterService
+from reporter_tpu.streaming.anonymiser import Anonymiser, TileSink, privacy_cull
+from reporter_tpu.streaming.batcher import Batch, PointBatcher
+from reporter_tpu.streaming.formatter import Formatter
+from reporter_tpu.streaming.worker import StreamWorker, inproc_submitter
+from reporter_tpu.synth import build_grid_city, generate_trace
+
+
+class TestFormatter:
+    def test_sv_with_date(self):
+        # the reference README's pipe-separated example
+        f = Formatter.from_config(",sv,\\|,1,9,10,0,5,yyyy-MM-dd HH:mm:ss")
+        uuid, p = f.format(
+            "2017-01-31 16:00:00|uuid_abcdef|x|x|x|51.3|x|x|x|3.465725|-76.5135033|x|x|x")
+        assert uuid == "uuid_abcdef"
+        assert p.lat == pytest.approx(3.465725)
+        assert p.lon == pytest.approx(-76.5135033)
+        assert p.accuracy == 52  # ceil(51.3)
+        assert p.time == 1485878400  # 2017-01-31T16:00:00Z
+
+    def test_json_epoch(self):
+        f = Formatter.from_config("@json@id@latitude@longitude@timestamp@accuracy")
+        uuid, p = f.format(json.dumps({
+            "timestamp": 1495037969, "id": "uuid_abcdef",
+            "accuracy": 51.305, "latitude": 3.465725,
+            "longitude": -76.5135033}))
+        assert uuid == "uuid_abcdef"
+        assert p.time == 1495037969
+        assert p.accuracy == 52
+
+    def test_bogus_config_rejected(self):
+        with pytest.raises(Exception):
+            Formatter.from_config(",nope,a,b")
+
+    def test_bogus_message_raises(self):
+        f = Formatter.from_config(",sv,\\|,1,9,10,0,5")
+        with pytest.raises(Exception):
+            f.format("not|enough|fields")
+
+
+class TestBatchThresholds:
+    def _pt(self, t, lat=14.6, lon=121.0):
+        return Point(lat, lon, 10, t)
+
+    def test_no_report_below_thresholds(self):
+        calls = []
+        b = Batch(self._pt(0))
+        for i in range(1, 5):
+            b.update(self._pt(i, lat=14.6 + i * 1e-4))
+        out = b.report("u", lambda t: calls.append(t) or {"shape_used": 1},
+                       "auto", "0,1", "0,1", 500, 10, 60)
+        assert out is None and not calls
+
+    def test_report_fires_and_trims(self):
+        b = Batch(self._pt(0))
+        # span >500m (0.01 deg ~ 1.1km), >10 points, >60s
+        for i in range(1, 12):
+            b.update(self._pt(i * 10, lat=14.6 + i * 0.001))
+        out = b.report("u", lambda t: {"shape_used": 5}, "auto", "0,1", "0,1",
+                       500, 10, 60)
+        assert out == {"shape_used": 5}
+        assert len(b.points) == 7  # 12 - 5
+
+    def test_bad_response_drops_batch(self):
+        b = Batch(self._pt(0))
+        for i in range(1, 12):
+            b.update(self._pt(i * 10, lat=14.6 + i * 0.001))
+        def boom(t):
+            raise RuntimeError("match exploded")
+        out = b.report("u", boom, "auto", "0,1", "0,1", 500, 10, 60)
+        assert out is None and b.points == []
+
+    def test_eviction_with_relaxed_thresholds(self):
+        submitted = []
+        forwarded = []
+        pb = PointBatcher(lambda t: submitted.append(t) or None,
+                          lambda k, s: forwarded.append((k, s)))
+        pb.process("veh", self._pt(0), stream_time_ms=0)
+        pb.process("veh", self._pt(5, lat=14.601), stream_time_ms=5000)
+        assert not submitted  # thresholds not met
+        pb.punctuate(stream_time_ms=200000)  # past the 60s session gap
+        assert len(submitted) == 1  # evicted with (0, 2, 0)
+        assert pb.store == {}
+
+
+class TestPrivacyCull:
+    def _seg(self, sid, nid):
+        return Segment(sid, nid, 10.0, 20.0, 100, 0)
+
+    def test_cull_below_threshold(self):
+        segs = sorted(
+            [self._seg(1, 2)] * 3 + [self._seg(1, 3)] + [self._seg(2, 2)] * 2,
+            key=Segment.sort_key)
+        out = privacy_cull(segs, privacy=2)
+        keys = {s.sort_key() for s in out}
+        assert (1, 3) not in keys
+        assert len(out) == 5
+
+    def test_privacy_one_keeps_all(self):
+        segs = [self._seg(1, 2), self._seg(1, 3)]
+        assert len(privacy_cull(sorted(segs, key=Segment.sort_key), 1)) == 2
+
+
+class TestEndToEndReplay:
+    """Replay synthetic sv-formatted probes through the full topology and
+    assert tiles land on disk (mirrors tests/circle.sh's asserts)."""
+
+    def test_replay_writes_tiles(self, tmp_path):
+        city = build_grid_city(rows=10, cols=10, spacing_m=200.0, seed=5,
+                               service_road_fraction=0.0,
+                               internal_fraction=0.0)
+        service = ReporterService(SegmentMatcher(net=city), threshold_sec=15,
+                                  max_batch=64, max_wait_ms=5.0)
+        out_dir = str(tmp_path / "results")
+
+        # manufacture raw sv messages from synthetic traces
+        rng = np.random.default_rng(9)
+        lines = []
+        for i in range(6):
+            tr = None
+            while tr is None:
+                tr = generate_trace(city, f"veh-{i}", rng, noise_m=3.0,
+                                    min_route_edges=8)
+            for p in tr.points:
+                lines.append("|".join([
+                    "x", tr.uuid, str(p["lat"]), str(p["lon"]),
+                    str(p["time"]), str(p["accuracy"])]))
+
+        # privacy 1 so single observations survive (like circle.sh -p 1)
+        worker = StreamWorker(
+            Formatter.from_config(",sv,\\|,1,2,3,4,5"),
+            inproc_submitter(service),
+            Anonymiser(TileSink(out_dir), privacy=1, quantisation=3600,
+                       source="test"),
+            flush_interval_s=1e9)  # flush only at drain
+        worker.run(lines)
+
+        assert worker.processed == len(lines)
+        assert worker.parse_failures == 0
+        # tiles exist and carry the reference's CSV header
+        tile_files = []
+        for root, _dirs, files in os.walk(out_dir):
+            tile_files.extend(os.path.join(root, f) for f in files)
+        assert tile_files, "no tiles written"
+        with open(tile_files[0]) as f:
+            header = f.readline().strip()
+        assert header == Segment.column_layout()
+        # every data row has 10 columns and the source/mode stamped
+        with open(tile_files[0]) as f:
+            rows = f.read().strip().split("\n")[1:]
+        assert rows
+        for row in rows:
+            cols = row.split(",")
+            assert len(cols) == 10
+            assert cols[8] == "test" and cols[9] == "AUTO"
