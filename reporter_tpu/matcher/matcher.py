@@ -15,7 +15,6 @@ Viterbi per padding bucket.
 from __future__ import annotations
 
 import json
-import threading
 from typing import List, Optional, Sequence
 
 import numpy as np
@@ -30,7 +29,6 @@ from .params import MatchParams
 
 # process-wide configuration, mirroring valhalla.Configure's module-level
 # behavior (reference: reporter_service.py:284)
-_configured = threading.local()
 _global_config: dict = {}
 
 
@@ -51,9 +49,11 @@ def Configure(conf) -> None:
 class SegmentMatcher:
     """Batched HMM matcher bound to one road network.
 
-    Thread-safe for concurrent Match calls (the reference instead creates
-    one C++ matcher per service thread, reporter_service.py:51-58; here a
-    single instance serves all threads and the service batches across them).
+    One instance serves the whole process (the reference instead creates
+    one C++ matcher per service thread, reporter_service.py:51-58). The
+    service serialises device work through its BatchDispatcher thread;
+    direct concurrent Match() calls are safe under CPython's GIL (the
+    shared RouteCache may redundantly recompute but never corrupts).
     """
 
     def __init__(self, net: Optional[RoadNetwork] = None,
@@ -71,7 +71,6 @@ class SegmentMatcher:
         self.params = params
         self.grid = SpatialGrid(net, cell_m=grid_cell_m)
         self.route_cache = RouteCache(net)
-        self._lock = threading.Lock()
 
     # -- single-trace, reference-shaped API --------------------------------
     def Match(self, trace_json: str) -> str:
@@ -95,20 +94,23 @@ class SegmentMatcher:
             prepared.append(prepare_trace(
                 self.net, self.grid, tr["trace"], params, self.route_cache))
 
-        # decode bucket by bucket; map paths back to input order
+        # sigma/beta are batch-wide scalars on device, so traces may only
+        # share a batch when their scoring params agree — group first, then
+        # bucket by length within each group
         paths: dict[int, np.ndarray] = {}
         index_of = {id(p): i for i, p in enumerate(prepared)}
-        for batch in pack_batches(prepared):
-            # sigma/beta are batch-wide; per-trace overrides of the scoring
-            # scalars fall back to the first trace's values in this batch
-            p0 = per_trace_params[index_of[id(batch.traces[0])]]
-            decoded, _scores = viterbi_decode_batch(
-                batch.dist_m, batch.valid, batch.route_m, batch.gc_m,
-                batch.case,
-                np.float32(p0.effective_sigma), np.float32(p0.beta))
-            decoded = np.asarray(decoded)
-            for b, ptrace in enumerate(batch.traces):
-                paths[index_of[id(ptrace)]] = decoded[b]
+        groups: dict[tuple, list] = {}
+        for p, params in zip(prepared, per_trace_params):
+            key = (params.effective_sigma, params.beta)
+            groups.setdefault(key, []).append(p)
+        for (sigma, beta), group in groups.items():
+            for batch in pack_batches(group):
+                decoded, _scores = viterbi_decode_batch(
+                    batch.dist_m, batch.valid, batch.route_m, batch.gc_m,
+                    batch.case, np.float32(sigma), np.float32(beta))
+                decoded = np.asarray(decoded)
+                for b, ptrace in enumerate(batch.traces):
+                    paths[index_of[id(ptrace)]] = decoded[b]
 
         results = []
         for i, (tr, ptrace) in enumerate(zip(traces, prepared)):

@@ -1,0 +1,3 @@
+from .assoc_viterbi import viterbi_assoc_batch, step_matrices
+
+__all__ = ["viterbi_assoc_batch", "step_matrices"]

@@ -1,0 +1,103 @@
+"""Associative-scan Viterbi: the sequence-parallel decode.
+
+The sequential Viterbi (matcher/hmm.py) is a ``lax.scan`` over T — correct
+and cheap in FLOPs, but its critical path is T dependent steps of tiny
+(K,K) work, and it cannot shard the time axis. This module reformulates
+the decode over the **max-plus semiring**, where a Viterbi step is a matrix
+"product":
+
+    (A @ B)[i, j] = max_k (A[i, k] + B[k, j])
+
+Step matrices ``M_t[i, j] = transition[t][i, j] + emission[t][j]`` compose
+associatively, so all prefix score vectors come out of one
+``jax.lax.associative_scan``: O(log T) depth, and the T axis becomes
+shardable across devices — the framework's sequence parallelism for
+long traces (the analog of ring attention's role in SURVEY.md's brief:
+splitting one long sequence across chips, here via GSPMD collectives
+instead of explicit ppermute).
+
+The RESTART/SKIP case encoding composes cleanly: a RESTART step's matrix
+is ``M[i, j] = em[j]`` (constant over i — resets the chain up to an
+argmax-invariant offset), a SKIP step's is the max-plus identity (0 on the
+diagonal). Both are exactly what ``transition_scores`` already emits, so
+``M = tr + em[1:, None, :]`` holds uniformly.
+
+Backpointers are *recomputed in parallel* from the prefix scores
+(bp_t[j] = argmax_i(scores[t-1, i] + tr[t-1, i, j])) — only the final
+backtrace is a sequential scan, and it is O(T) gathers of width K.
+
+Work: O(T K^3) vs the sequential O(T K^2) — for K=8..16 the extra FLOPs
+are noise next to the latency of T sequential dispatches, and the K^3
+inner op is a dense (K,K)x(K,K) reduction the TPU vector unit eats.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ..matcher.hmm import (
+    NEG_INF, RESTART, emission_scores, transition_scores)
+
+
+def _maxplus_matmul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """(..., K, K) max-plus product: (a @ b)[i,j] = max_k a[i,k] + b[k,j].
+
+    The broadcast sum is indexed (..., i, k, j); the contraction axis k is
+    axis -2.
+    """
+    return jnp.max(a[..., :, :, None] + b[..., None, :, :], axis=-2)
+
+
+def step_matrices(em: jnp.ndarray, tr: jnp.ndarray) -> jnp.ndarray:
+    """(T-1, K, K) composable step matrices from emission/transition scores."""
+    return tr + em[1:, None, :]
+
+
+def _viterbi_assoc_single(em: jnp.ndarray, tr: jnp.ndarray,
+                          case: jnp.ndarray):
+    """Associative-scan decode for one trace; same contract as
+    matcher.hmm._viterbi_single."""
+    T, K = em.shape
+    M = step_matrices(em, tr)                       # (T-1, K, K)
+    # prefix products P[t] = M_0 ∘ ... ∘ M_t  (in max-plus)
+    P = jax.lax.associative_scan(_maxplus_matmul, M, axis=0)
+    # forward score vectors for every prefix: scores[t] = init maxplus P[t-1]
+    init = em[0]                                    # (K,)
+    prefix = jnp.max(init[None, :, None] + P, axis=1)   # (T-1, K)
+    scores = jnp.concatenate([init[None], prefix])      # (T, K)
+
+    # parallel backpointer reconstruction from prefix scores
+    cand = scores[:-1, :, None] + tr                # (T-1, K, K)
+    bps = jnp.argmax(cand, axis=1).astype(jnp.int32)    # (T-1, K)
+    prev_bests = jnp.argmax(scores[:-1], axis=1).astype(jnp.int32)  # (T-1,)
+
+    last = jnp.argmax(scores[-1]).astype(jnp.int32)
+
+    def backward(cur, inp):
+        bp_t, prev_best_t, case_t = inp
+        prev = jnp.where(case_t == RESTART, prev_best_t, bp_t[cur])
+        return prev, cur
+
+    first, rest = jax.lax.scan(
+        backward, last, (bps, prev_bests, case[1:]), reverse=True)
+    path = jnp.concatenate([first[None], rest])
+    return path, jnp.max(scores[-1])
+
+
+@jax.jit
+def viterbi_assoc_batch(dist_m: jnp.ndarray, valid: jnp.ndarray,
+                        route_m: jnp.ndarray, gc_m: jnp.ndarray,
+                        case: jnp.ndarray, sigma: jnp.ndarray,
+                        beta: jnp.ndarray):
+    """Batch decode with the associative formulation; drop-in replacement
+    for matcher.hmm.viterbi_decode_batch — same shapes, same path quality
+    and total score (both accumulate across RESTART chains), with possible
+    differences only where f32 ordering flips exact score ties."""
+    def one(d, v, r, g, c):
+        em = emission_scores(d, v, c, sigma)
+        tr = transition_scores(r, g, c[1:], beta)
+        return _viterbi_assoc_single(em, tr, c)
+
+    return jax.vmap(one)(dist_m, valid, route_m, gc_m, case)

@@ -1,0 +1,4 @@
+from .mesh import make_mesh
+from .sharded import sharded_viterbi, shard_batch
+
+__all__ = ["make_mesh", "sharded_viterbi", "shard_batch"]

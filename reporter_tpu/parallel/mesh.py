@@ -1,0 +1,43 @@
+"""Device mesh construction for the sharded matcher.
+
+The reference scales by Kafka partitions across worker processes and
+machines (reference: SURVEY.md §2.4 — uuid-keyed partitions, manual
+multi-instance backfill). The TPU equivalent is a ``jax.sharding.Mesh``
+with two axes:
+
+  ``data`` — traces (the uuid/partition axis reborn): pure data
+             parallelism, no cross-device traffic in the decode
+  ``seq``  — the time axis of each trace (sequence parallelism): the
+             associative-scan decode composes step matrices across devices
+             via GSPMD-inserted collectives over ICI
+
+Multi-host runs get the same mesh over all processes' devices (JAX's
+standard multi-controller setup); ``data`` should map to the DCN-connected
+dimension and ``seq`` stay within a pod slice so the scan's collectives
+ride ICI.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def make_mesh(shape: Optional[Tuple[int, int]] = None,
+              axis_names: Sequence[str] = ("data", "seq"),
+              devices=None) -> Mesh:
+    """Build a 2D (data, seq) mesh over the available devices.
+
+    Default shape puts everything on ``data`` (n, 1) — the right default
+    for throughput serving; pass e.g. (n//2, 2) to shard long traces.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if shape is None:
+        shape = (n, 1)
+    if shape[0] * shape[1] != n:
+        raise ValueError(f"mesh shape {shape} != device count {n}")
+    arr = np.asarray(devices).reshape(shape)
+    return Mesh(arr, axis_names=tuple(axis_names))

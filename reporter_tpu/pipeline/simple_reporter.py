@@ -90,9 +90,13 @@ def _parse_part_file(path: str, valuer: Callable, time_pattern: str,
             shards.setdefault(shard, []).append(
                 f"{uuid},{epoch},{lat},{lon},{acc}\n")
             count += 1
+    # one shard file per worker process (suffix = pid): concurrent gather
+    # workers never share a file, so no interleaved/torn rows — stage 2
+    # walks every file in the directory regardless of suffix
+    pid = os.getpid()
     for shard, rows in shards.items():
-        with open(os.path.join(dest_dir, shard), "a") as f:
-            f.writelines(rows)
+        with open(os.path.join(dest_dir, f"{shard}.{pid}"), "a") as f:
+            f.write("".join(rows))
     return count
 
 
@@ -194,21 +198,27 @@ def match_traces(trace_dir: str, matcher, mode: str,
     from ..service.report import report as make_report
 
     dest_dir = tempfile.mkdtemp(prefix="matches_", dir=".")
-    shard_files = sorted(
-        os.path.join(r, f)
-        for r, _d, files in os.walk(trace_dir) for f in files)
+    # gather workers write one file per (shard, worker pid); all files with
+    # the same sha1-prefix shard belong together so a uuid's points are
+    # consolidated no matter which worker parsed them
+    by_shard: dict[str, list[str]] = {}
+    for r, _d, files in os.walk(trace_dir):
+        for f in files:
+            by_shard.setdefault(f.split(".")[0], []).append(
+                os.path.join(r, f))
     total_traces = 0
-    for shard in shard_files:
+    for shard, paths in sorted(by_shard.items()):
         by_uuid: dict[str, list[dict]] = {}
-        with open(shard) as f:
-            for line in f:
-                try:
-                    uuid, tm, lat, lon, acc = line.strip().split(",")
-                    by_uuid.setdefault(uuid, []).append({
-                        "lat": float(lat), "lon": float(lon),
-                        "time": int(tm), "accuracy": int(acc)})
-                except ValueError:
-                    continue
+        for path in paths:
+            with open(path) as f:
+                for line in f:
+                    try:
+                        uuid, tm, lat, lon, acc = line.strip().split(",")
+                        by_uuid.setdefault(uuid, []).append({
+                            "lat": float(lat), "lon": float(lon),
+                            "time": int(tm), "accuracy": int(acc)})
+                    except ValueError:
+                        continue
 
         # build every window request in this shard up front
         requests = []
@@ -394,7 +404,8 @@ def main(argv=None):
     parser.add_argument("--trace-dir", help="resume: pre-gathered traces")
     parser.add_argument("--match-dir", help="resume: pre-matched segments")
     parser.add_argument("--device-batch", type=int, default=512)
-    parser.add_argument("--cleanup", type=bool, default=True)
+    parser.add_argument("--cleanup", action=argparse.BooleanOptionalAction,
+                        default=True)
     args = parser.parse_args(argv)
 
     logging.basicConfig(level=logging.INFO,

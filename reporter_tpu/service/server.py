@@ -98,6 +98,9 @@ def make_handler(service: ReporterService):
 
         def _respond(self, code: int, body: str):
             raw = body.encode("utf-8")
+            # one request per connection, like the reference's HTTP/1.0
+            # service — keep-alive would pin a bounded pool slot idle
+            self.close_connection = True
             self.send_response(code)
             self.send_header("Access-Control-Allow-Origin", "*")
             self.send_header("Content-type", "application/json;charset=utf-8")
@@ -126,8 +129,40 @@ def make_handler(service: ReporterService):
     return Handler
 
 
-def serve(service: ReporterService, host: str, port: int) -> ThreadingHTTPServer:
-    httpd = ThreadingHTTPServer((host, port), make_handler(service))
+class BoundedThreadingHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer with a cap on concurrent handler threads —
+    honours the reference's THREAD_POOL_COUNT / THREAD_POOL_MULTIPLIER
+    sizing (reference: reporter_service.py:37-40). Excess connections
+    queue in the listen backlog until a slot frees."""
+
+    daemon_threads = True
+    # accepts queue here while all pool slots are busy
+    request_queue_size = 128
+
+    def __init__(self, addr, handler, pool_size: int | None = None):
+        if pool_size is None:
+            pool_size = int(os.environ.get(
+                "THREAD_POOL_COUNT",
+                int(os.environ.get("THREAD_POOL_MULTIPLIER", 1))
+                * multiprocessing.cpu_count()))
+        self._slots = threading.BoundedSemaphore(max(1, pool_size))
+        super().__init__(addr, handler)
+
+    def process_request(self, request, client_address):
+        self._slots.acquire()
+        super().process_request(request, client_address)
+
+    def process_request_thread(self, request, client_address):
+        try:
+            super().process_request_thread(request, client_address)
+        finally:
+            self._slots.release()
+
+
+def serve(service: ReporterService, host: str, port: int,
+          pool_size: int | None = None) -> BoundedThreadingHTTPServer:
+    httpd = BoundedThreadingHTTPServer((host, port), make_handler(service),
+                                       pool_size)
     thread = threading.Thread(target=httpd.serve_forever, daemon=True)
     thread.start()
     return httpd
@@ -148,14 +183,8 @@ def main(argv=None):
         sys.stderr.write(f"Problem with config file: {e}\n")
         return 1
 
-    # the reference sizes its pool from these env vars; honoured here for
-    # the accept/handler threads (reference: reporter_service.py:37-40)
-    _ = int(os.environ.get("THREAD_POOL_COUNT",
-            int(os.environ.get("THREAD_POOL_MULTIPLIER", 1))
-            * multiprocessing.cpu_count()))
-
     service = ReporterService(SegmentMatcher())
-    httpd = ThreadingHTTPServer((host, port), make_handler(service))
+    httpd = BoundedThreadingHTTPServer((host, port), make_handler(service))
     try:
         httpd.serve_forever()
     except KeyboardInterrupt:

@@ -1,0 +1,119 @@
+"""Associative-scan Viterbi equivalence + sharded execution on the virtual
+8-device CPU mesh."""
+import jax
+import numpy as np
+import pytest
+
+from reporter_tpu.matcher import MatchParams, SegmentMatcher
+from reporter_tpu.matcher.batchpad import pack_batches, prepare_trace
+from reporter_tpu.matcher.hmm import viterbi_decode_batch
+from reporter_tpu.ops import viterbi_assoc_batch
+from reporter_tpu.parallel import make_mesh, sharded_viterbi
+from reporter_tpu.synth import build_grid_city, generate_trace
+
+
+@pytest.fixture(scope="module")
+def city():
+    return build_grid_city(rows=10, cols=10, spacing_m=200.0, seed=6,
+                           service_road_fraction=0.0, internal_fraction=0.0)
+
+
+@pytest.fixture(scope="module")
+def batch(city):
+    m = SegmentMatcher(net=city)
+    prepared = []
+    rng = np.random.default_rng(0)
+    for i in range(8):
+        tr = None
+        while tr is None:
+            tr = generate_trace(city, f"v{i}", rng, noise_m=4.0,
+                                min_route_edges=8, max_route_edges=14)
+        prepared.append(prepare_trace(city, m.grid, tr.points, MatchParams(),
+                                      m.route_cache))
+    batches = pack_batches(prepared)
+    assert len(batches) == 1
+    return batches[0]
+
+
+def path_score_f64(batch, b, path):
+    """Re-score a decoded path in float64 numpy (independent of either
+    implementation's accumulation order)."""
+    from reporter_tpu.matcher.hmm import NORMAL, RESTART, SKIP
+    trace = batch.traces[b]
+    n = trace.num_kept
+    sigma, beta = 4.07, 3.0
+    total = 0.0
+    for t in range(n):
+        k = int(path[t])
+        d = float(batch.dist_m[b, t, k])
+        total += -0.5 * (d / sigma) ** 2
+        if t > 0 and batch.case[b, t] == NORMAL:
+            r = float(batch.route_m[b, t - 1, int(path[t - 1]), k])
+            assert r < 0.5e9, "decoded through an unroutable transition"
+            total += -abs(r - float(batch.gc_m[b, t - 1])) / beta
+    return total
+
+
+def test_assoc_matches_sequential(batch):
+    sigma, beta = np.float32(4.07), np.float32(3.0)
+    p_seq, _ = viterbi_decode_batch(
+        batch.dist_m, batch.valid, batch.route_m, batch.gc_m, batch.case,
+        sigma, beta)
+    p_assoc, _ = viterbi_assoc_batch(
+        batch.dist_m, batch.valid, batch.route_m, batch.gc_m, batch.case,
+        sigma, beta)
+    # the two decodes may break exact score ties differently (f32 summation
+    # order differs); equivalence means equal path *quality*
+    for b, trace in enumerate(batch.traces):
+        s1 = path_score_f64(batch, b, np.asarray(p_seq)[b])
+        s2 = path_score_f64(batch, b, np.asarray(p_assoc)[b])
+        assert s2 == pytest.approx(s1, abs=1e-2), f"trace {b}"
+
+
+def test_restart_semantics_equivalent():
+    # hand-built case with a restart in the middle and a skip tail
+    from reporter_tpu.matcher.hmm import NORMAL, RESTART, SKIP
+    B, T, K = 1, 6, 3
+    rng = np.random.default_rng(3)
+    dist = rng.uniform(0, 30, (B, T, K)).astype(np.float32)
+    valid = np.ones((B, T, K), bool)
+    gc = rng.uniform(5, 40, (B, T - 1)).astype(np.float32)
+    route = rng.uniform(5, 80, (B, T - 1, K, K)).astype(np.float32)
+    case = np.array([[RESTART, NORMAL, NORMAL, RESTART, NORMAL, SKIP]],
+                    np.int32)
+    sigma, beta = np.float32(4.07), np.float32(3.0)
+    p_seq, _ = viterbi_decode_batch(dist, valid, route, gc, case, sigma, beta)
+    p_assoc, _ = viterbi_assoc_batch(dist, valid, route, gc, case, sigma, beta)
+    np.testing.assert_array_equal(np.asarray(p_seq)[:, :5],
+                                  np.asarray(p_assoc)[:, :5])
+
+
+class TestMesh:
+    def test_make_mesh_shapes(self):
+        mesh = make_mesh()
+        assert mesh.devices.shape == (8, 1)
+        mesh2 = make_mesh((4, 2))
+        assert mesh2.axis_names == ("data", "seq")
+        with pytest.raises(ValueError):
+            make_mesh((3, 2))
+
+    def test_sharded_viterbi_matches_single_device(self, batch):
+        sigma, beta = np.float32(4.07), np.float32(3.0)
+        p_ref, _ = viterbi_decode_batch(
+            batch.dist_m, batch.valid, batch.route_m, batch.gc_m,
+            batch.case, sigma, beta)
+        mesh = make_mesh((4, 2))
+        run = sharded_viterbi(mesh)
+        p_sh, _ = run(batch.dist_m, batch.valid, batch.route_m, batch.gc_m,
+                      batch.case, sigma, beta)
+        for b in range(len(batch.traces)):
+            s_ref = path_score_f64(batch, b, np.asarray(p_ref)[b])
+            s_sh = path_score_f64(batch, b, np.asarray(p_sh)[b])
+            assert s_sh == pytest.approx(s_ref, abs=1e-2), f"trace {b}"
+
+    def test_sharded_uses_all_devices(self, batch):
+        mesh = make_mesh((8, 1))
+        run = sharded_viterbi(mesh)
+        p, _ = run(batch.dist_m, batch.valid, batch.route_m, batch.gc_m,
+                   batch.case, np.float32(4.07), np.float32(3.0))
+        assert len(p.sharding.device_set) == 8
