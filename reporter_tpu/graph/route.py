@@ -125,7 +125,9 @@ def route_distance(net: RoadNetwork, edge_a: int, offset_a: float,
         node_d = cache.distances_from(src, max_dist - via).get(dst)
     else:
         node_d = _dijkstra_bounded(net, src, max_dist - via).get(dst)
-    if node_d is None:
+    # a reused cache entry may have been computed at a larger bound and
+    # contain nodes beyond this query's cap — re-check the total
+    if node_d is None or via + node_d > max_dist:
         return float(UNREACHABLE)
     return via + node_d
 

@@ -75,17 +75,24 @@ def _select_kept(lat, lon, has_cands, interpolation_distance):
     return np.asarray(kept, dtype=np.int32)
 
 
-def prepare_trace(net: RoadNetwork, grid: SpatialGrid, points: Sequence[dict],
-                  params: MatchParams,
-                  cache: RouteCache | None = None) -> PreparedTrace:
-    """Candidates + route tensors + case codes for one trace, padded."""
+def prepare_trace(net: RoadNetwork, grid: SpatialGrid | None,
+                  points: Sequence[dict], params: MatchParams,
+                  cache: RouteCache | None = None,
+                  runtime=None) -> PreparedTrace:
+    """Candidates + route tensors + case codes for one trace, padded.
+
+    ``runtime`` (reporter_tpu.native.NativeRuntime) supplies C++ candidate
+    lookup and route matrices when available; the numpy ``grid`` + ``cache``
+    path is the fallback with identical semantics.
+    """
     num_raw = len(points)
     lat = np.array([p["lat"] for p in points], dtype=np.float64)
     lon = np.array([p["lon"] for p in points], dtype=np.float64)
     times = np.array([p["time"] for p in points], dtype=np.float64)
     K = params.max_candidates
 
-    all_cands = grid.candidates(lat, lon, K, params.search_radius)
+    lookup = runtime if runtime is not None else grid
+    all_cands = lookup.candidates(lat, lon, K, params.search_radius)
     has_cands = (all_cands.edge_ids != PAD_EDGE).any(axis=1)
     kept = _select_kept(lat, lon, has_cands, params.interpolation_distance)
     n = len(kept)
@@ -103,10 +110,15 @@ def prepare_trace(net: RoadNetwork, grid: SpatialGrid, points: Sequence[dict],
                            lat[kept[1:]], lon[kept[1:]]) if n > 1 else np.zeros(0)
     gc = np.atleast_1d(np.asarray(gc, dtype=np.float32))
 
-    route = candidate_route_matrices(
-        net, cands, gc,
-        max_route_distance_factor=params.max_route_distance_factor,
-        cache=cache)
+    if runtime is not None:
+        route = runtime.route_matrices(
+            cands, gc,
+            max_route_distance_factor=params.max_route_distance_factor)
+    else:
+        route = candidate_route_matrices(
+            net, cands, gc,
+            max_route_distance_factor=params.max_route_distance_factor,
+            cache=cache)
 
     # case codes over kept points: RESTART at the first point and after
     # breakage-sized gaps; SKIP only in the padding tail

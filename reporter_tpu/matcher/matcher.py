@@ -58,7 +58,8 @@ class SegmentMatcher:
 
     def __init__(self, net: Optional[RoadNetwork] = None,
                  params: Optional[MatchParams] = None,
-                 grid_cell_m: float = 250.0):
+                 grid_cell_m: float = 250.0,
+                 use_native: Optional[bool] = None):
         if net is None:
             graph_path = _global_config.get("graph")
             if graph_path is None:
@@ -69,8 +70,33 @@ class SegmentMatcher:
         if params is None:
             params = MatchParams(**_global_config.get("matcher", {}))
         self.params = params
-        self.grid = SpatialGrid(net, cell_m=grid_cell_m)
-        self.route_cache = RouteCache(net)
+        self._grid_cell_m = grid_cell_m
+        # the numpy structures are only built if the fallback path is used
+        # (the native runtime owns its own grid and cache)
+        self._grid: Optional[SpatialGrid] = None
+        self._route_cache: Optional[RouteCache] = None
+        # C++ host runtime when available (and not explicitly disabled);
+        # numpy fallback otherwise — identical contract
+        self.runtime = None
+        if use_native is not False:
+            from .. import native
+            if native.available():
+                self.runtime = native.NativeRuntime(net, cell_m=grid_cell_m)
+            elif use_native:
+                raise RuntimeError("native host runtime requested but "
+                                   "unavailable")
+
+    @property
+    def grid(self) -> SpatialGrid:
+        if self._grid is None:
+            self._grid = SpatialGrid(self.net, cell_m=self._grid_cell_m)
+        return self._grid
+
+    @property
+    def route_cache(self) -> RouteCache:
+        if self._route_cache is None:
+            self._route_cache = RouteCache(self.net)
+        return self._route_cache
 
     # -- single-trace, reference-shaped API --------------------------------
     def Match(self, trace_json: str) -> str:
@@ -91,8 +117,14 @@ class SegmentMatcher:
         for tr in traces:
             params = self.params.with_options(tr.get("match_options", {}))
             per_trace_params.append(params)
-            prepared.append(prepare_trace(
-                self.net, self.grid, tr["trace"], params, self.route_cache))
+            if self.runtime is not None:
+                prepared.append(prepare_trace(
+                    self.net, None, tr["trace"], params,
+                    runtime=self.runtime))
+            else:
+                prepared.append(prepare_trace(
+                    self.net, self.grid, tr["trace"], params,
+                    self.route_cache))
 
         # sigma/beta are batch-wide scalars on device, so traces may only
         # share a batch when their scoring params agree — group first, then

@@ -1,0 +1,157 @@
+"""ctypes binding for the C++ host runtime.
+
+Builds ``libreporter_host.so`` on demand with the repo's Makefile (g++ is
+baked into the image; pybind11 is not, hence the flat C ABI + ctypes).
+``available()`` gates callers: when the toolchain or build is missing the
+framework silently stays on the numpy implementations in
+:mod:`reporter_tpu.graph` — same contract, slower.
+
+ctypes releases the GIL during calls, so multiple Python threads can
+prepare traces through one NativeRuntime concurrently; the underlying
+route cache is per-handle and calls into one handle must be serialised by
+the caller (SegmentMatcher owns exactly one).
+"""
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+logger = logging.getLogger("reporter_tpu.native")
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_LIB_PATH = os.path.join(_DIR, "libreporter_host.so")
+_lib = None
+_build_lock = threading.Lock()
+_build_failed = False
+
+
+def _try_build() -> Optional[ctypes.CDLL]:
+    global _build_failed
+    with _build_lock:
+        if _build_failed:
+            return None
+        try:
+            src = os.path.join(_DIR, "src", "host_runtime.cpp")
+            if not (os.path.exists(_LIB_PATH) and os.path.exists(src)
+                    and os.path.getmtime(_LIB_PATH) >= os.path.getmtime(src)):
+                subprocess.run(["make", "-C", _DIR], check=True,
+                               capture_output=True, timeout=180)
+            return ctypes.CDLL(_LIB_PATH)
+        except Exception as e:
+            _build_failed = True
+            logger.warning("native host runtime unavailable (%s); "
+                           "falling back to numpy", e)
+            return None
+
+
+def _get_lib() -> Optional[ctypes.CDLL]:
+    global _lib
+    if _lib is None:
+        lib = _try_build()
+        if lib is None:
+            return None
+        c_i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+        c_f32p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
+        c_f64p = np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS")
+        lib.rt_graph_create.restype = ctypes.c_void_p
+        lib.rt_graph_create.argtypes = [
+            ctypes.c_int64, ctypes.c_int64, c_f64p, c_f64p, c_i32p, c_i32p,
+            c_f32p, ctypes.c_double]
+        lib.rt_graph_destroy.argtypes = [ctypes.c_void_p]
+        lib.rt_cache_clear.argtypes = [ctypes.c_void_p]
+        lib.rt_cache_size.argtypes = [ctypes.c_void_p]
+        lib.rt_cache_size.restype = ctypes.c_int64
+        lib.rt_candidates.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, c_f64p, c_f64p, ctypes.c_int32,
+            ctypes.c_double, c_i32p, c_f32p, c_f32p, c_f32p, c_f32p]
+        lib.rt_route_matrices.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int32, c_i32p, c_f32p,
+            c_f32p, ctypes.c_double, ctypes.c_double, c_f32p]
+        _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return _get_lib() is not None
+
+
+class NativeRuntime:
+    """C++-backed candidate lookup + route matrices for one RoadNetwork.
+
+    Drop-in for (SpatialGrid.candidates, candidate_route_matrices) — same
+    padding sentinels, same bounds semantics, same cache behavior.
+    """
+
+    def __init__(self, net, cell_m: float = 250.0):
+        lib = _get_lib()
+        if lib is None:
+            raise RuntimeError("native host runtime unavailable")
+        self._lib = lib
+        self.net = net
+        # rt_graph_create copies everything into C++ vectors, so the
+        # contiguous staging arrays only need to live for this call
+        nx, ny = net.node_xy()
+        self._handle = lib.rt_graph_create(
+            net.num_nodes, net.num_edges,
+            np.ascontiguousarray(nx, dtype=np.float64),
+            np.ascontiguousarray(ny, dtype=np.float64),
+            np.ascontiguousarray(net.edge_start, dtype=np.int32),
+            np.ascontiguousarray(net.edge_end, dtype=np.int32),
+            np.ascontiguousarray(net.edge_length_m, dtype=np.float32),
+            float(cell_m))
+
+    def __del__(self):
+        try:
+            if getattr(self, "_handle", None):
+                self._lib.rt_graph_destroy(self._handle)
+                self._handle = None
+        except Exception:
+            pass
+
+    # -- SpatialGrid-compatible candidate lookup ---------------------------
+    def candidates(self, lat, lon, k: int, search_radius_m: float = 50.0):
+        from ..graph.spatial import CandidateSet
+
+        to_xy, _ = self.net.projection()
+        px, py = to_xy(np.asarray(lat, dtype=np.float64),
+                       np.asarray(lon, dtype=np.float64))
+        px = np.ascontiguousarray(np.atleast_1d(px), dtype=np.float64)
+        py = np.ascontiguousarray(np.atleast_1d(py), dtype=np.float64)
+        T = len(px)
+        edge = np.empty((T, k), dtype=np.int32)
+        dist = np.empty((T, k), dtype=np.float32)
+        off = np.empty((T, k), dtype=np.float32)
+        qx = np.empty((T, k), dtype=np.float32)
+        qy = np.empty((T, k), dtype=np.float32)
+        self._lib.rt_candidates(self._handle, T, px, py, k,
+                                float(search_radius_m),
+                                edge, dist, off, qx, qy)
+        return CandidateSet(edge, dist, off, qx, qy)
+
+    # -- candidate_route_matrices-compatible -------------------------------
+    def route_matrices(self, cands, gc_dist,
+                       max_route_distance_factor: float = 5.0,
+                       min_bound_m: float = 500.0) -> np.ndarray:
+        T, K = cands.edge_ids.shape
+        out = np.empty((max(T - 1, 0), K, K), dtype=np.float32)
+        if T < 2:
+            return out
+        edge = np.ascontiguousarray(cands.edge_ids, dtype=np.int32)
+        off = np.ascontiguousarray(cands.offset_m, dtype=np.float32)
+        gc = np.ascontiguousarray(gc_dist, dtype=np.float32)
+        self._lib.rt_route_matrices(
+            self._handle, T, K, edge, off, gc,
+            float(max_route_distance_factor), float(min_bound_m), out)
+        return out
+
+    def cache_clear(self):
+        self._lib.rt_cache_clear(self._handle)
+
+    def cache_size(self) -> int:
+        return int(self._lib.rt_cache_size(self._handle))

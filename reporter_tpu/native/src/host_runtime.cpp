@@ -1,0 +1,278 @@
+// Native host runtime for reporter_tpu: spatial candidate lookup and
+// bounded-Dijkstra route-distance matrices.
+//
+// This is the framework's replacement for the native layer the reference
+// gets from Valhalla (reference: SURVEY.md §2.3 — tile reading, candidate
+// search and route distances all live in external C++ behind the `valhalla`
+// python module). Here the same responsibilities sit behind a flat C ABI
+// consumed via ctypes (no pybind11 in the image), emitting the fixed-width
+// tensors the JAX matcher wants.
+//
+// Graph model: directed edges between projected-meter node coordinates,
+// straight-segment geometry (matching reporter_tpu.graph.network). All
+// arrays are borrowed from numpy; the handle owns only its derived
+// structures (CSR, grid, caches).
+
+#include <algorithm>
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <queue>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+constexpr float kUnreachable = 1.0e9f;
+constexpr int32_t kPadEdge = -1;
+constexpr float kPadDist = 1.0e9f;
+
+struct Graph {
+  int64_t n_nodes = 0;
+  int64_t n_edges = 0;
+  std::vector<double> node_x, node_y;
+  std::vector<int32_t> edge_start, edge_end;
+  std::vector<float> edge_len;
+
+  // CSR out-adjacency
+  std::vector<int64_t> csr_off;
+  std::vector<int32_t> csr_edge;
+
+  // uniform spatial grid over projected meters
+  double cell = 250.0;
+  std::unordered_map<int64_t, std::vector<int32_t>> cells;
+
+  // per-source-node bounded dijkstra cache: node -> (bound, dists).
+  // guarded by route_mu: ctypes releases the GIL, so concurrent
+  // rt_route_matrices calls on one handle must serialise here
+  std::unordered_map<int32_t,
+                     std::pair<float, std::unordered_map<int32_t, float>>>
+      route_cache;
+  std::mutex route_mu;
+
+  static int64_t cell_key(int64_t i, int64_t j) {
+    // shift on the unsigned representation: << on negative values is UB
+    return static_cast<int64_t>((static_cast<uint64_t>(i) << 32) ^
+                                (static_cast<uint64_t>(j) & 0xffffffffULL));
+  }
+
+  void build(double cell_m) {
+    cell = cell_m;
+    // CSR
+    csr_off.assign(n_nodes + 1, 0);
+    for (int64_t e = 0; e < n_edges; ++e) csr_off[edge_start[e] + 1]++;
+    for (int64_t v = 0; v < n_nodes; ++v) csr_off[v + 1] += csr_off[v];
+    csr_edge.assign(n_edges, 0);
+    std::vector<int64_t> fill(csr_off.begin(), csr_off.end() - 1);
+    for (int64_t e = 0; e < n_edges; ++e)
+      csr_edge[fill[edge_start[e]]++] = static_cast<int32_t>(e);
+    // grid: every cell an edge's bbox touches
+    for (int64_t e = 0; e < n_edges; ++e) {
+      double ax = node_x[edge_start[e]], ay = node_y[edge_start[e]];
+      double bx = node_x[edge_end[e]], by = node_y[edge_end[e]];
+      int64_t i0 = static_cast<int64_t>(std::floor(std::min(ax, bx) / cell));
+      int64_t i1 = static_cast<int64_t>(std::floor(std::max(ax, bx) / cell));
+      int64_t j0 = static_cast<int64_t>(std::floor(std::min(ay, by) / cell));
+      int64_t j1 = static_cast<int64_t>(std::floor(std::max(ay, by) / cell));
+      for (int64_t i = i0; i <= i1; ++i)
+        for (int64_t j = j0; j <= j1; ++j)
+          cells[cell_key(i, j)].push_back(static_cast<int32_t>(e));
+    }
+  }
+
+  // bounded single-source dijkstra over nodes; reuses/extends cache entries
+  const std::unordered_map<int32_t, float>& dists_from(int32_t src,
+                                                       float bound) {
+    auto it = route_cache.find(src);
+    if (it != route_cache.end() && it->second.first >= bound)
+      return it->second.second;
+    std::unordered_map<int32_t, float> dist;
+    using QE = std::pair<float, int32_t>;
+    std::priority_queue<QE, std::vector<QE>, std::greater<QE>> heap;
+    dist[src] = 0.0f;
+    heap.push({0.0f, src});
+    while (!heap.empty()) {
+      auto [d, u] = heap.top();
+      heap.pop();
+      auto du = dist.find(u);
+      if (du != dist.end() && d > du->second) continue;
+      if (d > bound) break;
+      for (int64_t k = csr_off[u]; k < csr_off[u + 1]; ++k) {
+        int32_t e = csr_edge[k];
+        int32_t v = edge_end[e];
+        float nd = d + edge_len[e];
+        if (nd > bound) continue;
+        auto dv = dist.find(v);
+        if (dv == dist.end() || nd < dv->second) {
+          dist[v] = nd;
+          heap.push({nd, v});
+        }
+      }
+    }
+    auto& slot = route_cache[src];
+    slot.first = bound;
+    slot.second = std::move(dist);
+    return route_cache[src].second;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* rt_graph_create(int64_t n_nodes, int64_t n_edges,
+                      const double* node_x, const double* node_y,
+                      const int32_t* edge_start, const int32_t* edge_end,
+                      const float* edge_len, double cell_m) {
+  auto* g = new Graph();
+  g->n_nodes = n_nodes;
+  g->n_edges = n_edges;
+  g->node_x.assign(node_x, node_x + n_nodes);
+  g->node_y.assign(node_y, node_y + n_nodes);
+  g->edge_start.assign(edge_start, edge_start + n_edges);
+  g->edge_end.assign(edge_end, edge_end + n_edges);
+  g->edge_len.assign(edge_len, edge_len + n_edges);
+  g->build(cell_m);
+  return g;
+}
+
+void rt_graph_destroy(void* handle) { delete static_cast<Graph*>(handle); }
+
+void rt_cache_clear(void* handle) {
+  static_cast<Graph*>(handle)->route_cache.clear();
+}
+
+int64_t rt_cache_size(void* handle) {
+  return static_cast<int64_t>(
+      static_cast<Graph*>(handle)->route_cache.size());
+}
+
+// K nearest edges within radius for each of T projected points.
+// Outputs are (T, K) row-major, padded with kPadEdge / kPadDist / 0.
+void rt_candidates(void* handle, int64_t n_points, const double* px,
+                   const double* py, int32_t k, double radius,
+                   int32_t* out_edge, float* out_dist, float* out_off,
+                   float* out_px, float* out_py) {
+  auto* g = static_cast<Graph*>(handle);
+  const double cell = g->cell;
+  const int64_t reach = static_cast<int64_t>(std::ceil(radius / cell));
+  struct Cand {
+    double d;  // double so tie-ordering matches the numpy float64 sort
+    int32_t e;
+    float off, qx, qy;
+  };
+  std::vector<Cand> cands;
+  std::vector<char> seen(g->n_edges, 0);
+  std::vector<int32_t> seen_list;
+  for (int64_t t = 0; t < n_points; ++t) {
+    cands.clear();
+    for (int32_t s : seen_list) seen[s] = 0;
+    seen_list.clear();
+    const double x = px[t], y = py[t];
+    const int64_t ci = static_cast<int64_t>(std::floor(x / cell));
+    const int64_t cj = static_cast<int64_t>(std::floor(y / cell));
+    for (int64_t i = ci - reach; i <= ci + reach; ++i) {
+      for (int64_t j = cj - reach; j <= cj + reach; ++j) {
+        auto it = g->cells.find(Graph::cell_key(i, j));
+        if (it == g->cells.end()) continue;
+        for (int32_t e : it->second) {
+          if (seen[e]) continue;
+          seen[e] = 1;
+          seen_list.push_back(e);
+          const double ax = g->node_x[g->edge_start[e]];
+          const double ay = g->node_y[g->edge_start[e]];
+          const double bx = g->node_x[g->edge_end[e]];
+          const double by = g->node_y[g->edge_end[e]];
+          const double dx = bx - ax, dy = by - ay;
+          const double len2 = std::max(dx * dx + dy * dy, 1e-9);
+          double f = ((x - ax) * dx + (y - ay) * dy) / len2;
+          f = std::min(1.0, std::max(0.0, f));
+          const double qx = ax + f * dx, qy = ay + f * dy;
+          const double d = std::hypot(x - qx, y - qy);
+          if (d <= radius) {
+            cands.push_back({d, e, static_cast<float>(f * g->edge_len[e]),
+                             static_cast<float>(qx), static_cast<float>(qy)});
+          }
+        }
+      }
+    }
+    const int32_t n = static_cast<int32_t>(
+        std::min<size_t>(cands.size(), static_cast<size_t>(k)));
+    // stable top-K by distance, ties by edge id (matches numpy stable sort
+    // over edge-id-ordered input)
+    std::stable_sort(cands.begin(), cands.end(), [](const Cand& a,
+                                                    const Cand& b) {
+      return a.d < b.d || (a.d == b.d && a.e < b.e);
+    });
+    for (int32_t s = 0; s < k; ++s) {
+      const int64_t o = t * k + s;
+      if (s < n) {
+        out_edge[o] = cands[s].e;
+        out_dist[o] = static_cast<float>(cands[s].d);
+        out_off[o] = cands[s].off;
+        out_px[o] = cands[s].qx;
+        out_py[o] = cands[s].qy;
+      } else {
+        out_edge[o] = kPadEdge;
+        out_dist[o] = kPadDist;
+        out_off[o] = 0.0f;
+        out_px[o] = 0.0f;
+        out_py[o] = 0.0f;
+      }
+    }
+  }
+}
+
+// (T-1, K, K) route-distance tensor between consecutive candidate sets.
+// edge_ids/offsets are (T, K) row-major; gc is (T-1).
+void rt_route_matrices(void* handle, int64_t T, int32_t K,
+                       const int32_t* edge_ids, const float* offsets,
+                       const float* gc, double factor, double min_bound,
+                       float* out) {
+  auto* g = static_cast<Graph*>(handle);
+  // serialise cache access; candidate lookup stays lock-free (read-only)
+  std::lock_guard<std::mutex> lock(g->route_mu);
+  for (int64_t t = 0; t + 1 < T; ++t) {
+    const float bound = static_cast<float>(
+        std::max(min_bound, factor * static_cast<double>(gc[t])));
+    for (int32_t i = 0; i < K; ++i) {
+      const int32_t ea = edge_ids[t * K + i];
+      float* row = out + (t * K + i) * K;
+      if (ea == kPadEdge) {
+        for (int32_t j = 0; j < K; ++j) row[j] = kUnreachable;
+        continue;
+      }
+      const float oa = offsets[t * K + i];
+      const float remaining = g->edge_len[ea] - oa;
+      const int32_t src = g->edge_end[ea];
+      // one bounded search from ea's end node covers every target j
+      const auto& dist = g->dists_from(src, bound);
+      for (int32_t j = 0; j < K; ++j) {
+        const int32_t eb = edge_ids[(t + 1) * K + j];
+        if (eb == kPadEdge) {
+          row[j] = kUnreachable;
+          continue;
+        }
+        const float ob = offsets[(t + 1) * K + j];
+        if (eb == ea && ob >= oa) {
+          row[j] = ob - oa;
+          continue;
+        }
+        const float via = remaining + ob;
+        if (via > bound) {
+          row[j] = kUnreachable;
+          continue;
+        }
+        auto it = dist.find(g->edge_start[eb]);
+        // reachable only if the whole route fits inside the bound, matching
+        // the python fallback's max_dist semantics (graph/route.py)
+        row[j] = (it == dist.end() || via + it->second > bound)
+                     ? kUnreachable
+                     : via + it->second;
+      }
+    }
+  }
+}
+
+}  // extern "C"

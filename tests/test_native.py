@@ -1,0 +1,93 @@
+"""C++ host runtime vs numpy fallback: identical contracts."""
+import numpy as np
+import pytest
+
+from reporter_tpu import native
+from reporter_tpu.core.geo import equirectangular_m
+from reporter_tpu.graph import SpatialGrid, candidate_route_matrices
+from reporter_tpu.graph.route import RouteCache
+from reporter_tpu.graph.spatial import PAD_EDGE
+from reporter_tpu.synth import build_grid_city, generate_trace
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native toolchain unavailable")
+
+
+@pytest.fixture(scope="module")
+def city():
+    return build_grid_city(rows=12, cols=12, spacing_m=200.0, seed=8)
+
+
+@pytest.fixture(scope="module")
+def runtime(city):
+    return native.NativeRuntime(city)
+
+
+@pytest.fixture(scope="module")
+def trace(city):
+    rng = np.random.default_rng(21)
+    tr = None
+    while tr is None:
+        tr = generate_trace(city, "native-test", rng, noise_m=4.0,
+                            min_route_edges=8)
+    return tr
+
+
+def test_candidates_match_numpy(city, runtime, trace):
+    grid = SpatialGrid(city)
+    lat = np.array([p["lat"] for p in trace.points])
+    lon = np.array([p["lon"] for p in trace.points])
+    c_np = grid.candidates(lat, lon, k=8)
+    c_cc = runtime.candidates(lat, lon, k=8)
+    np.testing.assert_array_equal(c_cc.edge_ids, c_np.edge_ids)
+    np.testing.assert_allclose(c_cc.dist_m, c_np.dist_m, atol=1e-3)
+    np.testing.assert_allclose(c_cc.offset_m, c_np.offset_m, atol=1e-2)
+
+
+def test_route_matrices_match_numpy(city, runtime, trace):
+    grid = SpatialGrid(city)
+    lat = np.array([p["lat"] for p in trace.points])
+    lon = np.array([p["lon"] for p in trace.points])
+    cands = grid.candidates(lat, lon, k=8)
+    gc = np.asarray(equirectangular_m(lat[:-1], lon[:-1], lat[1:], lon[1:]),
+                    dtype=np.float32)
+    m_np = candidate_route_matrices(city, cands, gc, cache=RouteCache(city))
+    m_cc = runtime.route_matrices(cands, gc)
+    # unreachable entries agree exactly; reachable within float tolerance
+    np.testing.assert_array_equal(m_cc >= 0.5e9, m_np >= 0.5e9)
+    reachable = m_np < 0.5e9
+    np.testing.assert_allclose(m_cc[reachable], m_np[reachable], atol=0.5)
+
+
+def test_cache_grows_and_clears(city, runtime, trace):
+    runtime.cache_clear()
+    assert runtime.cache_size() == 0
+    grid = SpatialGrid(city)
+    lat = np.array([p["lat"] for p in trace.points])
+    lon = np.array([p["lon"] for p in trace.points])
+    cands = runtime.candidates(lat, lon, k=8)
+    gc = np.asarray(equirectangular_m(lat[:-1], lon[:-1], lat[1:], lon[1:]),
+                    dtype=np.float32)
+    runtime.route_matrices(cands, gc)
+    assert runtime.cache_size() > 0
+    runtime.cache_clear()
+    assert runtime.cache_size() == 0
+
+
+def test_matcher_uses_native_and_matches_fallback(city, trace):
+    from reporter_tpu.matcher import SegmentMatcher
+    m_native = SegmentMatcher(net=city, use_native=True)
+    m_py = SegmentMatcher(net=city, use_native=False)
+    assert m_native.runtime is not None and m_py.runtime is None
+    req = trace.request_json(report_levels=(0, 1, 2),
+                             transition_levels=(0, 1, 2))
+    out_native = m_native.match_many([req])[0]
+    out_py = m_py.match_many([req])[0]
+    ids_native = [s.get("segment_id") for s in out_native["segments"]]
+    ids_py = [s.get("segment_id") for s in out_py["segments"]]
+    assert ids_native == ids_py
+
+
+def test_no_candidates_far_away(city, runtime):
+    cands = runtime.candidates(np.array([15.9]), np.array([120.98]), k=4)
+    assert (cands.edge_ids == PAD_EDGE).all()
