@@ -36,13 +36,23 @@ def _try_build() -> Optional[ctypes.CDLL]:
     with _build_lock:
         if _build_failed:
             return None
+        src = os.path.join(_DIR, "src", "host_runtime.cpp")
+
+        def build():
+            subprocess.run(["make", "-C", _DIR], check=True,
+                           capture_output=True, timeout=180)
+
         try:
-            src = os.path.join(_DIR, "src", "host_runtime.cpp")
             if not (os.path.exists(_LIB_PATH) and os.path.exists(src)
                     and os.path.getmtime(_LIB_PATH) >= os.path.getmtime(src)):
-                subprocess.run(["make", "-C", _DIR], check=True,
-                               capture_output=True, timeout=180)
-            return ctypes.CDLL(_LIB_PATH)
+                build()
+            try:
+                return ctypes.CDLL(_LIB_PATH)
+            except OSError:
+                # a stale or foreign-platform .so can look up to date by
+                # mtime yet fail to load — rebuild once and retry
+                build()
+                return ctypes.CDLL(_LIB_PATH)
         except Exception as e:
             _build_failed = True
             logger.warning("native host runtime unavailable (%s); "

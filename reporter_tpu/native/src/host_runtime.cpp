@@ -140,12 +140,15 @@ void* rt_graph_create(int64_t n_nodes, int64_t n_edges,
 void rt_graph_destroy(void* handle) { delete static_cast<Graph*>(handle); }
 
 void rt_cache_clear(void* handle) {
-  static_cast<Graph*>(handle)->route_cache.clear();
+  auto* g = static_cast<Graph*>(handle);
+  std::lock_guard<std::mutex> lock(g->route_mu);
+  g->route_cache.clear();
 }
 
 int64_t rt_cache_size(void* handle) {
-  return static_cast<int64_t>(
-      static_cast<Graph*>(handle)->route_cache.size());
+  auto* g = static_cast<Graph*>(handle);
+  std::lock_guard<std::mutex> lock(g->route_mu);
+  return static_cast<int64_t>(g->route_cache.size());
 }
 
 // K nearest edges within radius for each of T projected points.

@@ -1,0 +1,7 @@
+"""Operational CLIs: replay producer, debug consumer, tile tooling.
+
+These mirror the reference's ops scripts (py/cat_to_kafka.py,
+py/make_requests.sh, py/get_tiles.py + py/download_tiles.sh,
+PrintConsumer.java) as first-class framework commands under
+``python -m reporter_tpu``.
+"""

@@ -1,0 +1,149 @@
+"""Ops tooling: replay producer, print-consumer rendering, tiles CLI,
+umbrella entry point."""
+import http.server
+import io
+import json
+import os
+import tarfile
+import threading
+
+import pytest
+
+from reporter_tpu.core.types import Point, Segment
+from reporter_tpu.tools.print_consumer import render
+from reporter_tpu.tools.replay import bbox_send_if, replay
+from reporter_tpu.tools.tiles_cli import download_tiles, list_tiles
+
+
+class TestReplay:
+    def test_lambdas_applied(self):
+        lines = ["a|1", "b|2", "skip|3"]
+        sent = []
+        n_sent, n_total = replay(
+            lines, lambda k, v: sent.append((k, v)),
+            key_with=lambda l: l.split("|")[0],
+            value_with=lambda l: l.upper(),
+            send_if=lambda l: not l.startswith("skip"))
+        assert n_sent == 2 and n_total == 3
+        assert sent == [("a", "A|1"), ("b", "B|2")]
+
+    def test_bad_line_skipped_not_fatal(self):
+        # reference: cat_to_kafka.py:62-65 — per-line failure logged, loop
+        # continues
+        lines = ["good", "bad", "good"]
+
+        def key_with(l):
+            if l == "bad":
+                raise ValueError("boom")
+            return l
+
+        sent = []
+        n_sent, n_total = replay(lines, lambda k, v: sent.append(k),
+                                 key_with=key_with)
+        assert n_sent == 2 and n_total == 3
+
+    def test_bbox_filter(self):
+        # reference: make_requests.sh:38-44
+        send_if = bbox_send_if([120.0, 14.0, 122.0, 16.0], "|", 1, 2)
+        assert send_if("uuid|15.0|121.0|0|10")
+        assert not send_if("uuid|17.0|121.0|0|10")
+        assert not send_if("uuid|not_a_number|121.0|0|10")
+
+    def test_cli_stdout_sink(self, capsys, tmp_path):
+        from reporter_tpu.tools.replay import main
+        src = tmp_path / "in.sv"
+        src.write_text("u1|15.0|121.0|0|10\nu2|99.0|121.0|0|10\n")
+        assert main([str(src), "--bbox", "120,14,122,16",
+                     "--lat-index", "1", "--lon-index", "2"]) == 0
+        out = capsys.readouterr().out
+        assert "u1|15.0" in out and "u2|99.0" not in out
+
+
+class TestPrintConsumer:
+    def test_renders_point(self):
+        p = Point(lat=14.6, lon=121.0, accuracy=10, time=1500000000)
+        assert "14.6" in render("formatted", "veh-1", p.to_bytes())
+
+    def test_renders_segment_list(self):
+        segs = [Segment(1, 2, 10.0, 20.0, 100, 0),
+                Segment(3, None, 20.0, 30.0, 50, 5)]
+        raw = b"".join(s.to_bytes() for s in segs)
+        text = render("segments", "1 2", raw)
+        assert "Segment" in text and "100" in text
+
+    def test_renders_utf8_and_binary(self):
+        assert render("raw", None, b"hello") == "None=hello"
+        assert render("raw", None, b"\xff\xfe") == "None=fffe"
+
+
+class TestTilesCli:
+    def test_list_matches_library(self):
+        from reporter_tpu.core.tiles import tiles_for_bbox
+        bbox = [120.9, 14.5, 121.1, 14.7]
+        assert list_tiles(bbox) == list(tiles_for_bbox(bbox))
+
+    def test_download_and_tar(self, tmp_path):
+        # serve fake tiles from a local dir over HTTP; one path 404s
+        bbox = [120.99, 14.59, 121.01, 14.61]
+        paths = list_tiles(bbox)
+        assert len(paths) >= 3  # one per level
+        src = tmp_path / "src"
+        for p in paths[:-1]:
+            f = src / p
+            f.parent.mkdir(parents=True, exist_ok=True)
+            f.write_bytes(b"tile:" + p.encode())
+
+        handler = lambda *a, **kw: http.server.SimpleHTTPRequestHandler(
+            *a, directory=str(src), **kw)
+        httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), handler)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        try:
+            url = f"http://127.0.0.1:{httpd.server_port}"
+            out = tmp_path / "out"
+            missing = download_tiles(bbox, url, str(out), processes=3,
+                                     tar_output=True)
+            assert missing == [paths[-1]]
+            for p in paths[:-1]:
+                assert (out / p).read_bytes() == b"tile:" + p.encode()
+            tars = [f for f in os.listdir(out) if f.endswith(".tar")]
+            assert len(tars) == 1
+            with tarfile.open(out / tars[0]) as tar:
+                assert sorted(tar.getnames()) == sorted(paths[:-1])
+        finally:
+            httpd.shutdown()
+
+
+class TestUmbrella:
+    def test_unknown_command(self, capsys):
+        from reporter_tpu.__main__ import main
+        assert main(["nope"]) == 2
+        assert "unknown command" in capsys.readouterr().err
+
+    def test_help(self, capsys):
+        from reporter_tpu.__main__ import main
+        assert main(["--help"]) == 0
+        assert "stream" in capsys.readouterr().out
+
+    def test_dispatch_tiles(self, capsys):
+        from reporter_tpu.__main__ import main
+        assert main(["tiles", "list", "--bbox", "120.9,14.5,121.1,14.7"]) == 0
+        assert "2/" in capsys.readouterr().out
+
+
+class TestSynthCli:
+    def test_sv_and_json_output(self, capsys):
+        from reporter_tpu.tools.synth_cli import main
+        assert main(["--traces", "2", "--rows", "6", "--cols", "6",
+                     "--format", "sv"]) == 0
+        sv = capsys.readouterr().out.strip().splitlines()
+        assert len(sv) >= 4
+        assert all(len(line.split("|")) == 5 for line in sv)
+        uuids = {line.split("|")[0] for line in sv}
+        assert uuids == {"synth-0", "synth-1"}
+
+        assert main(["--traces", "1", "--rows", "6", "--cols", "6",
+                     "--format", "json"]) == 0
+        body = json.loads(capsys.readouterr().out)
+        assert body["uuid"] == "synth-0"
+        assert len(body["trace"]) >= 2
+        assert body["match_options"]["report_levels"] == [0, 1]
