@@ -21,11 +21,11 @@ from __future__ import annotations
 
 import logging
 import os
-import urllib.request
 import uuid as uuidlib
 from typing import Dict, List
 
 from ..core.types import Segment, TimeQuantisedTile
+from ..utils import http as http_egress
 
 logger = logging.getLogger("reporter_tpu.streaming")
 
@@ -69,16 +69,13 @@ class TileSink:
 
     def store(self, tile_name: str, file_name: str, payload: str) -> bool:
         try:
-            if self.is_bucket:
-                return self._store_s3(tile_name + "/" + file_name, payload)
             if self.is_http:
-                req = urllib.request.Request(
-                    self.output + "/" + file_name, data=payload.encode(),
-                    method="POST",
-                    headers={"Content-Type": "text/plain;charset=utf-8"})
-                with urllib.request.urlopen(req, timeout=10):
-                    pass
-                return True
+                # signed PUT for AWS endpoints, plain POST otherwise
+                # (reference: AnonymisingProcessor.java:177-220)
+                return http_egress.egress_tile(
+                    self.output, tile_name + "/" + file_name, payload)
+            if self.is_bucket:  # s3:// form needs the SDK
+                return self._store_s3(tile_name + "/" + file_name, payload)
             path = os.path.join(self.output, tile_name)
             os.makedirs(path, exist_ok=True)
             with open(os.path.join(path, file_name), "w") as f:
@@ -93,11 +90,10 @@ class TileSink:
         try:
             import boto3  # gated: not present in all deployments
         except ImportError:
-            logger.error("s3 output configured but boto3 unavailable")
+            logger.error("s3:// output configured but boto3 unavailable; "
+                         "use an https bucket URL for SDK-less egress")
             return False
-        bucket = self.output.replace("s3://", "").split("/")[0] \
-            if self.output.startswith("s3://") else \
-            self.output.split("//")[1].split(".")[0]
+        bucket = self.output.replace("s3://", "").split("/")[0]
         boto3.client("s3").put_object(Bucket=bucket, Key=key,
                                       Body=payload.encode())
         return True

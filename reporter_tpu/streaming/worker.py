@@ -26,17 +26,14 @@ import json
 import logging
 import sys
 import time
-import urllib.request
 from typing import Callable, Iterable, Optional
 
+from ..utils import http as http_egress
 from .anonymiser import Anonymiser, TileSink
 from .batcher import PointBatcher, SESSION_GAP_MS
 from .formatter import Formatter
 
 logger = logging.getLogger("reporter_tpu.streaming")
-
-HTTP_RETRIES = 3           # reference: HttpClient.java:80-88
-HTTP_TIMEOUT_S = 10.0
 
 
 def http_submitter(url: str) -> Callable[[dict], Optional[dict]]:
@@ -44,19 +41,15 @@ def http_submitter(url: str) -> Callable[[dict], Optional[dict]]:
     policy; returns parsed JSON or None (reference: HttpClient.java:65-103).
     """
     def submit(trace: dict) -> Optional[dict]:
-        body = json.dumps(trace, separators=(",", ":")).encode()
-        for _ in range(HTTP_RETRIES):
-            try:
-                req = urllib.request.Request(
-                    url, data=body, method="POST",
-                    headers={"Content-Type": "application/json"})
-                with urllib.request.urlopen(req, timeout=HTTP_TIMEOUT_S) as r:
-                    return json.loads(r.read())
-            except Exception as e:
-                last = e
-        logger.error("POST %s failed after %d tries: %s",
-                     url, HTTP_RETRIES, last)
-        return None
+        body = json.dumps(trace, separators=(",", ":"))
+        text = http_egress.post(url, body, content_type="application/json")
+        if text is None:
+            return None
+        try:
+            return json.loads(text)
+        except json.JSONDecodeError as e:
+            logger.error("unparseable matcher response from %s: %s", url, e)
+            return None
     return submit
 
 

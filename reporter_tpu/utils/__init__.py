@@ -1,0 +1,2 @@
+"""Host-side utilities: HTTP egress, AWS signing."""
+from .http import aws_put, aws_signature, egress_tile, post, put  # noqa: F401

@@ -1,0 +1,130 @@
+"""HTTP egress with retries + legacy AWS v2 S3 signing, stdlib only.
+
+Equivalent of the reference's HttpClient (HttpClient.java): POST/PUT with
+3 attempts and 1 s connect / 10 s socket timeouts (HttpClient.java:80-88),
+errors swallowed and logged with None returned (:95-98), and hand-rolled
+HMAC-SHA1 "AWS key:signature" authorization for S3 PUTs (:34-58) so tile
+egress needs no AWS SDK. Credentials come from the standard environment
+variables, as in the reference (AnonymisingProcessor.java:88-97).
+"""
+from __future__ import annotations
+
+import base64
+import email.utils
+import hashlib
+import hmac
+import logging
+import os
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Mapping, Optional
+
+logger = logging.getLogger("reporter_tpu.http")
+
+ATTEMPTS = 3           # reference: HttpClient.java:88
+CONNECT_TIMEOUT = 1.0  # reference: HttpClient.java:81
+SOCKET_TIMEOUT = 10.0  # reference: HttpClient.java:83
+
+
+def aws_signature(sign_me: str, secret: str) -> str:
+    """Base64(HMAC-SHA1(secret, sign_me)) (reference: HttpClient.java:34-40)."""
+    mac = hmac.new(secret.encode(), sign_me.encode(), hashlib.sha1)
+    return base64.b64encode(mac.digest()).decode()
+
+
+def _do(method: str, url: str, body: bytes,
+        headers: Mapping[str, str]) -> Optional[str]:
+    """Issue the request with up to ATTEMPTS tries; swallow-and-log failure
+    (reference: HttpClient.java:74-103). Returns the response body or None."""
+    last = None
+    for attempt in range(ATTEMPTS):
+        try:
+            req = urllib.request.Request(url, data=body, method=method,
+                                         headers=dict(headers))
+            # urllib has one deadline knob; use the socket timeout (the
+            # connect phase is bounded by it too)
+            with urllib.request.urlopen(req, timeout=SOCKET_TIMEOUT) as resp:
+                return resp.read().decode()
+        except urllib.error.HTTPError as e:
+            # the server answered; 4xx (except throttling) won't improve
+            # on retry
+            last = e
+            try:
+                e.read()
+            except Exception:
+                pass
+            if e.code < 500 and e.code != 429:
+                break
+        except Exception as e:
+            last = e
+        if attempt + 1 < ATTEMPTS:
+            time.sleep(CONNECT_TIMEOUT * (attempt + 1))
+    logger.error("After %d attempts couldn't %s to %s -> %s",
+                 ATTEMPTS, method, url, last)
+    return None
+
+
+def post(url: str, body: str,
+         content_type: str = "text/plain;charset=utf-8",
+         headers: Optional[Mapping[str, str]] = None) -> Optional[str]:
+    h = {"Content-Type": content_type}
+    h.update(headers or {})
+    return _do("POST", url, body.encode(), h)
+
+
+def put(url: str, body: str,
+        content_type: str = "text/plain;charset=utf-8",
+        headers: Optional[Mapping[str, str]] = None) -> Optional[str]:
+    h = {"Content-Type": content_type}
+    h.update(headers or {})
+    return _do("PUT", url, body.encode(), h)
+
+
+def aws_put(url: str, location: str, body: str, key: str, secret: str,
+            content_type: str = "text/plain;charset=utf-8",
+            date: Optional[str] = None) -> Optional[str]:
+    """Signed S3 PUT of ``body`` to ``{url}/{location}``.
+
+    ``url`` is a virtual-hosted bucket endpoint like
+    ``https://bucket.s3.amazonaws.com`` with an optional key prefix path;
+    the bucket is the first label of the host and the canonical resource
+    is ``/bucket/<prefix>/<location>`` (reference: HttpClient.java:44-58).
+    ``date`` overrides the RFC-1123 GMT timestamp (tests only).
+    """
+    parsed = urllib.parse.urlsplit(url)
+    host = parsed.netloc
+    bucket = host.split(".")[0]
+    prefix = parsed.path.strip("/")
+    full_key = f"{prefix}/{location}" if prefix else location
+    if date is None:
+        date = email.utils.formatdate(usegmt=True)
+    resource = f"/{bucket}/{full_key}"
+    sign_me = f"PUT\n\n{content_type}\n{date}\n{resource}"
+    headers = {
+        "Host": host,
+        "Date": date,
+        "Authorization": f"AWS {key}:{aws_signature(sign_me, secret)}",
+    }
+    return put(f"{parsed.scheme}://{host}/{full_key}", body,
+               content_type=content_type, headers=headers)
+
+
+def egress_tile(dest: str, key_path: str, payload: str) -> bool:
+    """Shared tile-egress routing for the streaming anonymiser and the
+    batch pipeline (reference: AnonymisingProcessor.java:177-220): an AWS
+    bucket endpoint (``*.amazonaws.com``) gets a signed PUT using env
+    credentials, failing closed without them; any other http(s) endpoint
+    gets a plain POST. Returns success.
+    """
+    host = urllib.parse.urlsplit(dest).netloc
+    if host.endswith("amazonaws.com"):
+        access = os.environ.get("AWS_ACCESS_KEY_ID")
+        secret = os.environ.get("AWS_SECRET_ACCESS_KEY")
+        if not access or not secret:
+            logger.error("bucket destination %s needs AWS_ACCESS_KEY_ID/"
+                         "AWS_SECRET_ACCESS_KEY in the environment", dest)
+            return False
+        return aws_put(dest, key_path, payload, access, secret) is not None
+    return post(dest.rstrip("/") + "/" + key_path, payload) is not None

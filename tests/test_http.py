@@ -1,0 +1,155 @@
+"""HTTP egress: retries, swallow-and-log, AWS v2 signing."""
+import base64
+import hashlib
+import hmac
+import http.server
+import threading
+
+import pytest
+
+from reporter_tpu.utils import http as rhttp
+
+
+@pytest.fixture
+def server():
+    """Local HTTP server recording requests; scriptable status codes."""
+    state = {"requests": [], "codes": []}
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def _handle(self):
+            length = int(self.headers.get("Content-Length", 0))
+            state["requests"].append({
+                "method": self.command,
+                "path": self.path,
+                "headers": dict(self.headers),
+                "body": self.rfile.read(length).decode(),
+            })
+            code = state["codes"].pop(0) if state["codes"] else 200
+            self.send_response(code)
+            body = b"ok" if code == 200 else b"err"
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        do_POST = do_PUT = _handle
+
+        def log_message(self, fmt, *args):
+            pass
+
+    httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    state["url"] = f"http://127.0.0.1:{httpd.server_port}"
+    yield state
+    httpd.shutdown()
+
+
+class TestRetries:
+    def test_post_ok(self, server):
+        assert rhttp.post(server["url"] + "/x", "hello") == "ok"
+        (req,) = server["requests"]
+        assert req["method"] == "POST" and req["body"] == "hello"
+        assert req["headers"]["Content-Type"] == "text/plain;charset=utf-8"
+
+    def test_5xx_retried_then_succeeds(self, server, monkeypatch):
+        monkeypatch.setattr(rhttp.time, "sleep", lambda s: None)
+        server["codes"] = [500, 502]
+        assert rhttp.put(server["url"] + "/x", "v") == "ok"
+        assert len(server["requests"]) == 3
+
+    def test_4xx_not_retried(self, server, monkeypatch):
+        monkeypatch.setattr(rhttp.time, "sleep", lambda s: None)
+        server["codes"] = [403]
+        assert rhttp.post(server["url"] + "/x", "v") is None
+        assert len(server["requests"]) == 1
+
+    def test_connection_refused_swallowed(self, monkeypatch):
+        # reference: HttpClient.java:95-98 — errors swallowed, null returned
+        monkeypatch.setattr(rhttp.time, "sleep", lambda s: None)
+        assert rhttp.post("http://127.0.0.1:9/x", "v") is None
+
+
+class TestAwsSigning:
+    def test_signature_is_hmac_sha1_base64(self):
+        expected = base64.b64encode(
+            hmac.new(b"secret", b"sign me", hashlib.sha1).digest()).decode()
+        assert rhttp.aws_signature("sign me", "secret") == expected
+
+    def test_aws_put_canonical_headers(self, monkeypatch):
+        # reference: HttpClient.java:44-58 — resource is /bucket/<key>,
+        # string-to-sign is PUT\n\n{type}\n{date}\n{resource}
+        captured = {}
+
+        def fake_put(url, body, content_type=None, headers=None):
+            captured.update(url=url, body=body, headers=headers)
+            return "ok"
+
+        monkeypatch.setattr(rhttp, "put", fake_put)
+        date = "Tue, 27 Mar 2007 21:15:45 +0000"
+        assert rhttp.aws_put("https://speeds.s3.amazonaws.com",
+                             "t/1/2/tile.csv", "payload",
+                             "AKID", "secret", date=date) == "ok"
+        assert captured["url"] == \
+            "https://speeds.s3.amazonaws.com/t/1/2/tile.csv"
+        assert captured["headers"]["Host"] == "speeds.s3.amazonaws.com"
+        assert captured["headers"]["Date"] == date
+        sign_me = ("PUT\n\ntext/plain;charset=utf-8\n" + date
+                   + "\n/speeds/t/1/2/tile.csv")
+        assert captured["headers"]["Authorization"] == \
+            "AWS AKID:" + rhttp.aws_signature(sign_me, "secret")
+
+    def test_aws_put_with_key_prefix(self, monkeypatch):
+        # a path on the bucket URL is a key prefix, not part of the host
+        captured = {}
+
+        def fake_put(url, body, content_type=None, headers=None):
+            captured.update(url=url, headers=headers)
+            return "ok"
+
+        monkeypatch.setattr(rhttp, "put", fake_put)
+        date = "Tue, 27 Mar 2007 21:15:45 +0000"
+        rhttp.aws_put("https://speeds.s3.amazonaws.com/manila/v1",
+                      "tile.csv", "p", "AKID", "secret", date=date)
+        assert captured["url"] == \
+            "https://speeds.s3.amazonaws.com/manila/v1/tile.csv"
+        assert captured["headers"]["Host"] == "speeds.s3.amazonaws.com"
+        sign_me = ("PUT\n\ntext/plain;charset=utf-8\n" + date
+                   + "\n/speeds/manila/v1/tile.csv")
+        assert captured["headers"]["Authorization"] == \
+            "AWS AKID:" + rhttp.aws_signature(sign_me, "secret")
+
+
+class TestEgressTile:
+    def test_plain_http_routes_to_post(self, server):
+        assert rhttp.egress_tile(server["url"], "1_2/0/3/src.abc", "csv")
+        (req,) = server["requests"]
+        assert req["method"] == "POST"
+        assert req["path"] == "/1_2/0/3/src.abc"
+
+    def test_aws_host_routes_to_signed_put(self, monkeypatch):
+        calls = {}
+        monkeypatch.setenv("AWS_ACCESS_KEY_ID", "AKID")
+        monkeypatch.setenv("AWS_SECRET_ACCESS_KEY", "sec")
+        monkeypatch.setattr(
+            rhttp, "aws_put",
+            lambda url, key, body, a, s, **kw: calls.update(url=url, key=key)
+            or "ok")
+        assert rhttp.egress_tile("https://b.s3.amazonaws.com", "k/t.csv", "p")
+        assert calls == {"url": "https://b.s3.amazonaws.com", "key": "k/t.csv"}
+
+    def test_aws_host_without_creds_fails_closed(self, monkeypatch):
+        monkeypatch.delenv("AWS_ACCESS_KEY_ID", raising=False)
+        monkeypatch.delenv("AWS_SECRET_ACCESS_KEY", raising=False)
+        called = []
+        monkeypatch.setattr(rhttp, "aws_put",
+                            lambda *a, **kw: called.append(a) or "ok")
+        assert not rhttp.egress_tile("https://b.s3.amazonaws.com", "k", "p")
+        assert called == []
+
+    def test_tile_sink_http_uses_egress(self, server):
+        from reporter_tpu.streaming.anonymiser import TileSink
+        sink = TileSink(server["url"])
+        assert sink.store("1_2/0/3", "src.abc", "csv,data") is True
+        (req,) = server["requests"]
+        assert req["method"] == "POST"
+        assert req["path"] == "/1_2/0/3/src.abc"
+        assert req["body"] == "csv,data"
