@@ -11,6 +11,7 @@ PrintConsumer):
   replay           flat file/stdin -> topic/stdout producer [cat_to_kafka]
   print-consumer   debug-print a topic                      [PrintConsumer]
   tiles            list/download graph tiles for a bbox     [get_tiles et al]
+  graph            build/tile/inspect road networks   [valhalla build tools]
   synth            synthetic GPS trace generator      [generate_test_trace]
 """
 from __future__ import annotations
@@ -66,6 +67,12 @@ def _tiles():
 @_cmd("synth")
 def _synth():
     from .tools.synth_cli import main
+    return main
+
+
+@_cmd("graph")
+def _graph():
+    from .tools.graph_cli import main
     return main
 
 

@@ -83,8 +83,60 @@ def _get_lib() -> Optional[ctypes.CDLL]:
         lib.rt_route_matrices.argtypes = [
             ctypes.c_void_p, ctypes.c_int64, ctypes.c_int32, c_i32p, c_f32p,
             c_f32p, ctypes.c_double, ctypes.c_double, c_f32p]
+        c_i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+        c_u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+        i64ref = ctypes.POINTER(ctypes.c_int64)
+        lib.rt_tile_counts.restype = ctypes.c_int32
+        lib.rt_tile_counts.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, i64ref, i64ref, i64ref]
+        lib.rt_tile_parse.restype = ctypes.c_int32
+        lib.rt_tile_parse.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, c_i64p, c_f64p, c_f64p,
+            c_i32p, c_i32p, c_f32p, c_f32p, c_i64p, c_f32p, c_u8p,
+            c_i64p, c_f32p]
         _lib = lib
     return _lib
+
+
+def parse_tile(raw: bytes):
+    """Parse an RGT1 graph-tile blob with the C++ parser; returns the
+    column dict (tilestore layout) or None when the library is missing or
+    the blob is malformed (caller falls back to the numpy parser for the
+    error message)."""
+    lib = _get_lib()
+    if lib is None:
+        return None
+    n_nodes = ctypes.c_int64()
+    n_edges = ctypes.c_int64()
+    n_segs = ctypes.c_int64()
+    if lib.rt_tile_counts(raw, len(raw), ctypes.byref(n_nodes),
+                          ctypes.byref(n_edges), ctypes.byref(n_segs)) != 0:
+        return None
+    N, E, S = n_nodes.value, n_edges.value, n_segs.value
+    out = {
+        "node_gid": np.empty(N, np.int64),
+        "node_lat": np.empty(N, np.float64),
+        "node_lon": np.empty(N, np.float64),
+        "edge_start": np.empty(E, np.int32),
+        "edge_end": np.empty(E, np.int32),
+        "edge_length_m": np.empty(E, np.float32),
+        "edge_speed_kph": np.empty(E, np.float32),
+        "edge_segment_id": np.empty(E, np.int64),
+        "edge_segment_offset_m": np.empty(E, np.float32),
+        "edge_internal": np.empty(E, np.uint8),
+        "seg_ids": np.empty(S, np.int64),
+        "seg_lens": np.empty(S, np.float32),
+    }
+    rc = lib.rt_tile_parse(
+        raw, len(raw), out["node_gid"], out["node_lat"], out["node_lon"],
+        out["edge_start"], out["edge_end"], out["edge_length_m"],
+        out["edge_speed_kph"], out["edge_segment_id"],
+        out["edge_segment_offset_m"], out["edge_internal"],
+        out["seg_ids"], out["seg_lens"])
+    if rc != 0:
+        return None
+    out["edge_internal"] = out["edge_internal"].astype(bool)
+    return out
 
 
 def available() -> bool:

@@ -279,3 +279,79 @@ void rt_route_matrices(void* handle, int64_t T, int32_t K,
 }
 
 }  // extern "C"
+
+// ---- RGT1 graph-tile parser (reporter_tpu/graph/tilestore.py layout) ----
+// The native analog of the reference's C++ tile reader (SURVEY.md §2.3):
+// header "RGT1" + u32 version + i64 n_nodes/n_edges/n_segments, then the
+// column arrays little-endian in declaration order.
+
+namespace {
+constexpr int64_t kRgtHeaderSize = 4 + 4 + 3 * 8;
+
+template <typename T>
+bool rgt_copy(const uint8_t* buf, int64_t len, int64_t& off, T* out,
+              int64_t count) {
+  const int64_t bytes = count * static_cast<int64_t>(sizeof(T));
+  if (off + bytes > len) return false;
+  std::memcpy(out, buf + off, bytes);
+  off += bytes;
+  return true;
+}
+}  // namespace
+
+extern "C" {
+
+// Fills counts from the header. Returns 0 on success, nonzero on a
+// malformed tile. Counts are validated against the blob length so a
+// corrupt header can neither drive huge allocations in the caller nor
+// overflow the per-column size math below.
+int32_t rt_tile_counts(const uint8_t* buf, int64_t len, int64_t* n_nodes,
+                       int64_t* n_edges, int64_t* n_segs) {
+  if (len < kRgtHeaderSize || std::memcmp(buf, "RGT1", 4) != 0) return 1;
+  uint32_t version;
+  std::memcpy(&version, buf + 4, 4);
+  if (version != 1) return 2;
+  std::memcpy(n_nodes, buf + 8, 8);
+  std::memcpy(n_edges, buf + 16, 8);
+  std::memcpy(n_segs, buf + 24, 8);
+  if (*n_nodes < 0 || *n_edges < 0 || *n_segs < 0) return 3;
+  // each count also fits in the blob on its own, so the exact-size sum
+  // below cannot overflow int64
+  if (*n_nodes > len || *n_edges > len || *n_segs > len) return 3;
+  const int64_t expect = kRgtHeaderSize + *n_nodes * (8 + 8 + 8) +
+                         *n_edges * (4 + 4 + 4 + 4 + 8 + 4 + 1) +
+                         *n_segs * (8 + 4);
+  if (expect != len) return 3;
+  return 0;
+}
+
+// Copies every column into caller-allocated arrays sized from
+// rt_tile_counts. Returns 0 on success, nonzero on truncation/trailing
+// bytes.
+int32_t rt_tile_parse(const uint8_t* buf, int64_t len, int64_t* node_gid,
+                      double* node_lat, double* node_lon,
+                      int32_t* edge_start, int32_t* edge_end,
+                      float* edge_length_m, float* edge_speed_kph,
+                      int64_t* edge_segment_id, float* edge_segment_offset_m,
+                      uint8_t* edge_internal, int64_t* seg_ids,
+                      float* seg_lens) {
+  int64_t N, E, S;
+  const int32_t rc = rt_tile_counts(buf, len, &N, &E, &S);
+  if (rc != 0) return rc;
+  int64_t off = kRgtHeaderSize;
+  if (!rgt_copy(buf, len, off, node_gid, N)) return 4;
+  if (!rgt_copy(buf, len, off, node_lat, N)) return 4;
+  if (!rgt_copy(buf, len, off, node_lon, N)) return 4;
+  if (!rgt_copy(buf, len, off, edge_start, E)) return 4;
+  if (!rgt_copy(buf, len, off, edge_end, E)) return 4;
+  if (!rgt_copy(buf, len, off, edge_length_m, E)) return 4;
+  if (!rgt_copy(buf, len, off, edge_speed_kph, E)) return 4;
+  if (!rgt_copy(buf, len, off, edge_segment_id, E)) return 4;
+  if (!rgt_copy(buf, len, off, edge_segment_offset_m, E)) return 4;
+  if (!rgt_copy(buf, len, off, edge_internal, E)) return 4;
+  if (!rgt_copy(buf, len, off, seg_ids, S)) return 4;
+  if (!rgt_copy(buf, len, off, seg_lens, S)) return 4;
+  return off == len ? 0 : 5;
+}
+
+}  // extern "C"

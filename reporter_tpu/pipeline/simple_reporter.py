@@ -318,8 +318,8 @@ def _report_worker(files: List[str], match_dir: str, dest: str,
         payload = Segment.column_layout() + "\n" + "".join(kept)
         key = rel + "/" + name
         logger.info("Writing %d segments to %s", len(kept), key)
-        if dest.startswith(("s3://", "http://", "https://")):
-            _put_s3(dest, key, payload)
+        if _is_remote(dest):
+            _put_remote(dest, key, payload)
         else:
             out_path = os.path.join(dest, key)
             os.makedirs(os.path.dirname(out_path), exist_ok=True)
@@ -327,10 +327,14 @@ def _report_worker(files: List[str], match_dir: str, dest: str,
                 f.write(payload)
 
 
-def _put_s3(dest: str, key: str, payload: str) -> None:
-    if dest.startswith(("http://", "https://")):
-        # signed PUT for AWS endpoints, plain POST otherwise — same
-        # routing as the streaming TileSink
+def _is_remote(dest: str) -> bool:
+    return dest.startswith(("s3://", "http://", "https://"))
+
+
+def _put_remote(dest: str, key: str, payload: str) -> None:
+    if not dest.startswith("s3://"):
+        # signed PUT / boto3 for AWS endpoints, plain POST otherwise —
+        # same routing as the streaming TileSink
         from ..utils import http as http_egress
         http_egress.egress_tile(dest, key, payload)
         return
@@ -351,7 +355,7 @@ def report_tiles(match_dir: str, dest: str, privacy: int,
         os.path.join(r, f)
         for r, _d, fs in os.walk(match_dir) for f in fs)
     logger.info("Reporting %d anonymised time tiles", len(files))
-    if not dest.startswith(("s3://", "http://", "https://")):
+    if not _is_remote(dest):
         os.makedirs(dest, exist_ok=True)
     chunks = [files[i::concurrency] for i in range(concurrency)]
     procs = []

@@ -95,7 +95,7 @@ def aws_put(url: str, location: str, body: str, key: str, secret: str,
     """
     parsed = urllib.parse.urlsplit(url)
     host = parsed.netloc
-    bucket = host.split(".")[0]
+    bucket = (parsed.hostname or "").split(".")[0]
     prefix = parsed.path.strip("/")
     full_key = f"{prefix}/{location}" if prefix else location
     if date is None:
@@ -111,20 +111,43 @@ def aws_put(url: str, location: str, body: str, key: str, secret: str,
                content_type=content_type, headers=headers)
 
 
+def is_aws_host(dest: str) -> bool:
+    host = urllib.parse.urlsplit(dest).hostname or ""
+    return host == "amazonaws.com" or host.endswith(".amazonaws.com")
+
+
 def egress_tile(dest: str, key_path: str, payload: str) -> bool:
     """Shared tile-egress routing for the streaming anonymiser and the
     batch pipeline (reference: AnonymisingProcessor.java:177-220): an AWS
-    bucket endpoint (``*.amazonaws.com``) gets a signed PUT using env
+    bucket endpoint goes through boto3 when installed (SigV4, full
+    credential chain), else a hand-rolled legacy-signed PUT from env
     credentials, failing closed without them; any other http(s) endpoint
     gets a plain POST. Returns success.
     """
-    host = urllib.parse.urlsplit(dest).netloc
-    if host.endswith("amazonaws.com"):
+    if is_aws_host(dest):
+        parsed = urllib.parse.urlsplit(dest)
+        bucket = (parsed.hostname or "").split(".")[0]
+        prefix = parsed.path.strip("/")
+        key = f"{prefix}/{key_path}" if prefix else key_path
+        try:
+            import boto3  # gated: not in every deployment
+        except ImportError:
+            boto3 = None
+        if boto3 is not None:
+            try:
+                boto3.client("s3").put_object(Bucket=bucket, Key=key,
+                                              Body=payload.encode())
+                return True
+            except Exception as e:
+                logger.error("boto3 put_object to %s/%s failed: %s",
+                             bucket, key, e)
+                return False
         access = os.environ.get("AWS_ACCESS_KEY_ID")
         secret = os.environ.get("AWS_SECRET_ACCESS_KEY")
         if not access or not secret:
-            logger.error("bucket destination %s needs AWS_ACCESS_KEY_ID/"
-                         "AWS_SECRET_ACCESS_KEY in the environment", dest)
+            logger.error("bucket destination %s needs boto3 or "
+                         "AWS_ACCESS_KEY_ID/AWS_SECRET_ACCESS_KEY in the "
+                         "environment", dest)
             return False
         return aws_put(dest, key_path, payload, access, secret) is not None
     return post(dest.rstrip("/") + "/" + key_path, payload) is not None

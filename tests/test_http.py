@@ -145,6 +145,12 @@ class TestEgressTile:
         assert not rhttp.egress_tile("https://b.s3.amazonaws.com", "k", "p")
         assert called == []
 
+    def test_aws_host_matching(self):
+        assert rhttp.is_aws_host("https://b.s3.amazonaws.com")
+        assert rhttp.is_aws_host("https://b.s3.amazonaws.com:443/prefix")
+        assert not rhttp.is_aws_host("https://my-amazonaws.com")
+        assert not rhttp.is_aws_host("http://127.0.0.1:8080")
+
     def test_tile_sink_http_uses_egress(self, server):
         from reporter_tpu.streaming.anonymiser import TileSink
         sink = TileSink(server["url"])
