@@ -91,7 +91,7 @@ def main():
 
     from reporter_tpu.matcher.batchpad import pack_batches
     from reporter_tpu.matcher.assemble import assemble_segments
-    from reporter_tpu.ops.assoc_viterbi import viterbi_assoc_batch
+    from reporter_tpu.ops import decode_batch, decode_backend
     from reporter_tpu.service.report import report as make_report
 
     platform = jax.devices()[0].platform
@@ -104,17 +104,17 @@ def main():
 
     # -- warmup / compile both shapes ------------------------------------
     b0 = batches[0]
-    viterbi_assoc_batch(b0.dist_m, b0.valid, b0.route_m, b0.gc_m, b0.case,
+    decode_batch(b0.dist_m, b0.valid, b0.route_m, b0.gc_m, b0.case,
                         sigma, beta)[0].block_until_ready()
     single = pack_batches(prepared[:1])[0]
-    viterbi_assoc_batch(single.dist_m, single.valid, single.route_m,
+    decode_batch(single.dist_m, single.valid, single.route_m,
                         single.gc_m, single.case, sigma, beta)[0].block_until_ready()
 
     # -- baseline leg: one trace per device call -------------------------
     t0 = time.perf_counter()
     for i, p in enumerate(prepared[:n_base]):
         sb = pack_batches([p])[0]
-        paths, _ = viterbi_assoc_batch(sb.dist_m, sb.valid, sb.route_m,
+        paths, _ = decode_batch(sb.dist_m, sb.valid, sb.route_m,
                                        sb.gc_m, sb.case, sigma, beta)
         paths.block_until_ready()
         match = assemble_segments(city, p, np.asarray(paths)[0])
@@ -127,7 +127,7 @@ def main():
         t0 = time.perf_counter()
         idx = 0
         for b in batches:
-            paths, _ = viterbi_assoc_batch(b.dist_m, b.valid, b.route_m,
+            paths, _ = decode_batch(b.dist_m, b.valid, b.route_m,
                                            b.gc_m, b.case, sigma, beta)
             paths = np.asarray(paths)
             for j, p in enumerate(b.traces):
@@ -140,7 +140,8 @@ def main():
     print(json.dumps({
         "metric": f"synthetic-city traces/sec map-matched end-to-end "
                   f"(decode+assemble+report, T={T_bucket}, K={K}, "
-                  f"platform={platform}) batched vs one-trace-per-call",
+                  f"platform={platform}, decode={decode_backend(T_bucket, K)}) "
+                  f"batched vs one-trace-per-call",
         "value": round(batched_tps, 1),
         "unit": "traces/sec",
         "vs_baseline": round(batched_tps / baseline_tps, 2),

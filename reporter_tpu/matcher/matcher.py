@@ -24,7 +24,6 @@ from ..graph.route import RouteCache
 from ..graph.spatial import SpatialGrid
 from .assemble import assemble_segments
 from .batchpad import pack_batches, prepare_trace
-from .hmm import viterbi_decode_batch
 from .params import MatchParams
 
 # process-wide configuration, mirroring valhalla.Configure's module-level
@@ -126,6 +125,10 @@ class SegmentMatcher:
                     self.net, self.grid, tr["trace"], params,
                     self.route_cache))
 
+        # deferred: importing at module level would cycle through
+        # ops -> pallas_viterbi -> matcher.hmm -> matcher/__init__
+        from ..ops import decode_batch
+
         # sigma/beta are batch-wide scalars on device, so traces may only
         # share a batch when their scoring params agree — group first, then
         # bucket by length within each group
@@ -137,7 +140,7 @@ class SegmentMatcher:
             groups.setdefault(key, []).append(p)
         for (sigma, beta), group in groups.items():
             for batch in pack_batches(group):
-                decoded, _scores = viterbi_decode_batch(
+                decoded, _scores = decode_batch(
                     batch.dist_m, batch.valid, batch.route_m, batch.gc_m,
                     batch.case, np.float32(sigma), np.float32(beta))
                 decoded = np.asarray(decoded)

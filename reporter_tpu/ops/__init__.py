@@ -1,3 +1,55 @@
-from .assoc_viterbi import viterbi_assoc_batch, step_matrices
+"""Device decode kernels and backend dispatch.
 
-__all__ = ["viterbi_assoc_batch", "step_matrices"]
+Three implementations of the batched Viterbi decode, one contract:
+
+  scan    lax.scan over T (matcher/hmm.py) — simplest, T dependent steps
+  assoc   associative scan over max-plus step matrices — log-depth,
+          shardable along T (sequence parallelism)
+  pallas  fused single-program forward recurrence in VMEM — minimal
+          work and launches on TPU hardware
+
+``decode_batch`` picks per call: honours REPORTER_TPU_DECODE
+(scan|assoc|pallas) when set; otherwise assoc. Measured on one TPU chip at
+(B=512, T=64, K=8): end-to-end service throughput is identical across the
+three (~2250 traces/s — host-side segment assembly dominates); device-
+resident decode favours assoc (~26 ms vs ~64 ms for scan/pallas per 512
+traces), so assoc is the default and pallas stays opt-in until it wins.
+"""
+import os
+
+import jax
+
+from .assoc_viterbi import step_matrices, viterbi_assoc_batch
+from .pallas_viterbi import (
+    VMEM_BUDGET_BYTES,
+    viterbi_pallas_batch,
+    vmem_bytes_estimate,
+)
+
+__all__ = ["viterbi_assoc_batch", "viterbi_pallas_batch", "step_matrices",
+           "decode_batch"]
+
+
+def decode_backend(T: int, K: int) -> str:
+    forced = os.environ.get("REPORTER_TPU_DECODE", "").strip().lower()
+    if forced == "pallas" and vmem_bytes_estimate(T, K) > VMEM_BUDGET_BYTES:
+        return "assoc"  # bucket too large for the fused kernel's VMEM
+    if forced in ("scan", "assoc", "pallas"):
+        return forced
+    return "assoc"
+
+
+def decode_batch(dist_m, valid, route_m, gc_m, case, sigma, beta):
+    """Backend-dispatched batched Viterbi decode; same contract as
+    matcher.hmm.viterbi_decode_batch."""
+    backend = decode_backend(T=dist_m.shape[1], K=dist_m.shape[2])
+    if backend == "pallas":
+        interpret = jax.default_backend() != "tpu"
+        return viterbi_pallas_batch(dist_m, valid, route_m, gc_m, case,
+                                    sigma, beta, interpret=interpret)
+    if backend == "assoc":
+        return viterbi_assoc_batch(dist_m, valid, route_m, gc_m, case,
+                                   sigma, beta)
+    from ..matcher.hmm import viterbi_decode_batch
+    return viterbi_decode_batch(dist_m, valid, route_m, gc_m, case,
+                                sigma, beta)

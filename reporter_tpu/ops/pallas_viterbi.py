@@ -1,0 +1,136 @@
+"""Fused Pallas TPU kernel for the Viterbi forward recurrence.
+
+The other two decode paths pay for generality: the ``lax.scan`` version
+(matcher/hmm.py) launches T tiny dependent steps through XLA, and the
+associative-scan version (ops/assoc_viterbi.py) does O(K^3) work per step
+to buy log-depth. This kernel does the minimal O(T K^2) work in ONE fused
+program per batch block: the whole recurrence runs out of VMEM with the
+batch laid across vector lanes, so the T-step dependence chain never
+leaves the chip.
+
+Layout: the batch dimension B is the *lane* axis (128-wide) and K sits on
+sublanes — for the service's K=8..16 and f32 this is exactly the TPU's
+native (8, 128) tile. Per grid step the kernel owns a (T, K, 128) emission
+block, a (T-1, K, K, 128) transition block, and the recurrence
+
+    scores[t+1, j, b] = max_i(scores[t, i, b] + tr[t, i, j, b]) + em[t+1, j, b]
+    bps[t, j, b]      = argmax_i(...)
+
+is uniform across NORMAL/RESTART/SKIP because ``transition_scores``
+already encodes the case semantics into ``tr`` (identity for SKIP, zeros
+for RESTART — matcher/hmm.py:57-72). The backtrace is O(T K) gathers,
+done outside the kernel in XLA where gathers are cheap.
+
+VMEM budget gates dispatch: a (T, K) bucket needs roughly
+(T*K + 2*T*K + (T-1)*K*K) * 128 * 4 bytes resident; buckets beyond the
+budget fall back to the associative path (ops/__init__.decode_batch).
+
+Measured (one real chip, B=512/T=64/K=8): end-to-end service throughput
+ties the assoc backend (~2250 traces/s; host assembly dominates), while
+device-resident decode measured slower than assoc through the chip
+tunnel (~64 ms vs ~26 ms per 512 traces) — hence opt-in via
+REPORTER_TPU_DECODE=pallas rather than the default, pending profiling
+on directly-attached hardware.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..matcher.hmm import emission_scores, transition_scores
+
+LANES = 128
+# stay well under the ~16MB/core VMEM
+VMEM_BUDGET_BYTES = 12 * 1024 * 1024
+
+
+def vmem_bytes_estimate(T: int, K: int) -> int:
+    """Resident bytes per grid step: em + tr in, final + bps out — times
+    two, because pallas_call double-buffers every block for pipelining."""
+    per_lane = (T * K + (T - 1) * K * K + K + (T - 1) * K) * 4
+    return per_lane * LANES * 2
+
+
+def _forward_kernel(em_ref, tr_ref, final_ref, bps_ref):
+    T = em_ref.shape[0]
+
+    def body(t, prev):
+        # prev: (K, LANES) running scores; tr_ref[t]: (K, K, LANES)
+        cand = prev[:, None, :] + tr_ref[t]          # (K_prev, K_cur, LANES)
+        bps_ref[t] = jnp.argmax(cand, axis=0).astype(jnp.int32)
+        return jnp.max(cand, axis=0) + em_ref[t + 1]
+
+    # only the final timestep's scores leave the kernel — the backtrace
+    # needs just the backpointers
+    final_ref[:] = jax.lax.fori_loop(0, T - 1, body, em_ref[0])
+
+
+def _forward_pallas(emT: jnp.ndarray, trT: jnp.ndarray, interpret: bool):
+    """emT (T, K, Bp), trT (T-1, K, K, Bp) with Bp % LANES == 0.
+    Returns final scores (K, Bp), bps (T-1, K, Bp)."""
+    T, K, Bp = emT.shape
+    grid = (Bp // LANES,)
+    return pl.pallas_call(
+        _forward_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((T, K, LANES), lambda b: (0, 0, b),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((T - 1, K, K, LANES), lambda b: (0, 0, 0, b),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((K, LANES), lambda b: (0, b),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((T - 1, K, LANES), lambda b: (0, 0, b),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((K, Bp), jnp.float32),
+            jax.ShapeDtypeStruct((T - 1, K, Bp), jnp.int32),
+        ],
+        interpret=interpret,
+    )(emT, trT)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def viterbi_pallas_batch(dist_m: jnp.ndarray, valid: jnp.ndarray,
+                         route_m: jnp.ndarray, gc_m: jnp.ndarray,
+                         case: jnp.ndarray, sigma: jnp.ndarray,
+                         beta: jnp.ndarray, interpret: bool = False):
+    """Drop-in replacement for matcher.hmm.viterbi_decode_batch with the
+    forward recurrence fused into one Pallas program per batch block.
+    ``interpret=True`` runs the kernel in the Pallas interpreter
+    (CPU-testable, same numerics)."""
+    B, T, K = dist_m.shape
+
+    em = jax.vmap(lambda d, v, c: emission_scores(d, v, c, sigma))(
+        dist_m, valid, case)                              # (B, T, K)
+    tr = jax.vmap(lambda r, g, c: transition_scores(r, g, c[1:], beta))(
+        route_m, gc_m, case)                              # (B, T-1, K, K)
+
+    pad = (-B) % LANES
+    emT = jnp.pad(em, ((0, pad), (0, 0), (0, 0))).transpose(1, 2, 0)
+    trT = jnp.pad(tr, ((0, pad), (0, 0), (0, 0), (0, 0))).transpose(1, 2, 3, 0)
+
+    final, bps = _forward_pallas(emT, trT, interpret)
+    final = final.transpose(1, 0)[:B]                     # (B, K)
+    bps = bps.transpose(2, 0, 1)[:B]                      # (B, T-1, K)
+
+    last = jnp.argmax(final, axis=-1).astype(jnp.int32)   # (B,)
+
+    def backtrace(last_b, bps_b):
+        # RESTART steps need no special case: their tr rows are constant
+        # over i, so bp_t[cur] is already argmax(prev_scores)
+        def backward(cur, bp_t):
+            return bp_t[cur], cur
+
+        first, rest = jax.lax.scan(backward, last_b, bps_b, reverse=True)
+        return jnp.concatenate([first[None], rest])
+
+    paths = jax.vmap(backtrace)(last, bps)                # (B, T)
+    return paths, jnp.max(final, axis=-1)

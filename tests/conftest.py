@@ -23,6 +23,13 @@ from jax._src import xla_bridge
 # jax was already imported by sitecustomize, so the env var change above
 # came too late for its config — update it directly as well
 jax.config.update("jax_platforms", "cpu")
+
+# pallas registers MLIR lowering rules for the "tpu" platform at import
+# time, which fails once the factory below is popped — import it first
+# (tests then run pallas kernels in interpret mode on cpu)
+from jax.experimental import pallas as _pl  # noqa: F401,E402
+from jax.experimental.pallas import tpu as _pltpu  # noqa: F401,E402
+
 for _name in list(xla_bridge._backend_factories):
     if _name != "cpu":
         xla_bridge._backend_factories.pop(_name, None)

@@ -1,0 +1,96 @@
+"""Pallas fused Viterbi vs the scan and associative decoders (interpret
+mode on CPU: same kernel code path as TPU, same numerics)."""
+import numpy as np
+import pytest
+
+from reporter_tpu.matcher.hmm import (
+    NORMAL,
+    RESTART,
+    SKIP,
+    viterbi_decode_batch,
+)
+from reporter_tpu.ops import (
+    decode_backend,
+    decode_batch,
+    viterbi_assoc_batch,
+    viterbi_pallas_batch,
+    vmem_bytes_estimate,
+    VMEM_BUDGET_BYTES,
+)
+
+
+def random_inputs(B, T, K, seed, with_restarts=True, with_skips=True):
+    rng = np.random.default_rng(seed)
+    dist = rng.uniform(0.0, 40.0, (B, T, K)).astype(np.float32)
+    valid = rng.random((B, T, K)) > 0.1
+    valid[:, :, 0] = True  # at least one candidate everywhere
+    gc = rng.uniform(5.0, 40.0, (B, T - 1)).astype(np.float32)
+    route = (gc[..., None, None]
+             + rng.exponential(15.0, (B, T - 1, K, K))).astype(np.float32)
+    # sprinkle unreachable routes
+    route[rng.random(route.shape) < 0.05] = 1.0e9
+    case = np.full((B, T), NORMAL, dtype=np.int32)
+    case[:, 0] = RESTART
+    if with_restarts:
+        for b in range(B):
+            for t in rng.integers(2, T - 1, size=2):
+                case[b, t] = RESTART
+    if with_skips:
+        for b in range(B):
+            n_skip = int(rng.integers(0, T // 4))
+            if n_skip:
+                case[b, T - n_skip:] = SKIP
+    return (dist, valid, route, gc, case,
+            np.float32(4.07), np.float32(3.0))
+
+
+class TestParity:
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    @pytest.mark.parametrize("shape", [(4, 16, 4), (3, 33, 8), (2, 64, 8)])
+    def test_matches_scan_and_assoc(self, seed, shape):
+        B, T, K = shape
+        args = random_inputs(B, T, K, seed)
+        p_paths, p_scores = viterbi_pallas_batch(*args, interpret=True)
+        s_paths, s_scores = viterbi_decode_batch(*args)
+        a_paths, a_scores = viterbi_assoc_batch(*args)
+        np.testing.assert_allclose(np.asarray(p_scores),
+                                   np.asarray(s_scores), rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(a_scores),
+                                   np.asarray(s_scores), rtol=1e-5)
+        # paths may differ only where exact score ties flip; require exact
+        # agreement on these inputs (continuous random scores -> no ties)
+        np.testing.assert_array_equal(np.asarray(p_paths),
+                                      np.asarray(s_paths))
+
+    def test_batch_not_multiple_of_lanes(self):
+        args = random_inputs(5, 12, 3, seed=7)
+        p_paths, p_scores = viterbi_pallas_batch(*args, interpret=True)
+        s_paths, s_scores = viterbi_decode_batch(*args)
+        np.testing.assert_array_equal(np.asarray(p_paths),
+                                      np.asarray(s_paths))
+        np.testing.assert_allclose(np.asarray(p_scores),
+                                   np.asarray(s_scores), rtol=1e-5)
+
+
+class TestDispatch:
+    def test_env_override(self, monkeypatch):
+        monkeypatch.setenv("REPORTER_TPU_DECODE", "scan")
+        assert decode_backend(64, 8) == "scan"
+        monkeypatch.setenv("REPORTER_TPU_DECODE", "pallas")
+        assert decode_backend(64, 8) == "pallas"
+
+    def test_default_off_tpu_is_assoc(self, monkeypatch):
+        monkeypatch.delenv("REPORTER_TPU_DECODE", raising=False)
+        assert decode_backend(64, 8) == "assoc"  # tests run on cpu
+
+    def test_vmem_estimate_gates_large_buckets(self):
+        assert vmem_bytes_estimate(64, 8) <= VMEM_BUDGET_BYTES
+        assert vmem_bytes_estimate(4096, 64) > VMEM_BUDGET_BYTES
+
+    def test_decode_batch_dispatches(self, monkeypatch):
+        args = random_inputs(3, 16, 4, seed=3)
+        monkeypatch.setenv("REPORTER_TPU_DECODE", "pallas")
+        p = decode_batch(*args)
+        monkeypatch.setenv("REPORTER_TPU_DECODE", "scan")
+        s = decode_batch(*args)
+        np.testing.assert_array_equal(np.asarray(p[0]), np.asarray(s[0]))
