@@ -1,0 +1,48 @@
+# Single image containing every service in the framework — the matcher
+# HTTP service (default CMD), the streaming worker, the batch pipeline,
+# and the ops tools — mirroring the reference's one-image layout
+# (reference: Dockerfile:1-56, which bundled the Java worker and the
+# Python matcher service with Valhalla installed from a PPA).
+#
+# TPU deployments build FROM a jax[tpu] base on the TPU VM instead of
+# installing jax[cpu]; everything else is identical.
+FROM python:3.12-slim
+
+# native toolchain for the C++ host runtime (the reference instead
+# apt-installed prebuilt valhalla, Dockerfile:29-32)
+RUN apt-get update && \
+    apt-get install -y --no-install-recommends g++ make curl && \
+    rm -rf /var/lib/apt/lists/*
+
+# CPU jax by default; TPU images override (see comment above)
+RUN pip install --no-cache-dir "jax[cpu]" numpy
+
+WORKDIR /srv/reporter
+COPY reporter_tpu/ reporter_tpu/
+COPY tests/ tests/
+COPY bench.py README.md ./
+
+# build the C++ host runtime (spatial index + bounded Dijkstra,
+# native/src/host_runtime.cpp)
+RUN make -C reporter_tpu/native
+
+# bake a default synthetic-city graph + matcher config so the image runs
+# out of the box; production mounts a real graph over /data (the
+# reference instead baked a valhalla config + tile dir, Dockerfile:42-49)
+RUN mkdir -p /data && \
+    python -m reporter_tpu graph build-synth --rows 20 --cols 20 \
+        --spacing-m 200 --seed 0 --out /data/graph.npz && \
+    printf '{"graph": "/data/graph.npz"}\n' > /data/reporter.json
+
+ENV PYTHONUNBUFFERED=1 \
+    THRESHOLD_SEC=15 \
+    MATCH_BATCH_MAX=256 \
+    MATCH_BATCH_WAIT_MS=20
+
+EXPOSE 8002
+# default service, like the reference's CMD reporter_service.py
+# (Dockerfile:55); other entry points:
+#   python -m reporter_tpu stream ...      (streaming worker)
+#   python -m reporter_tpu pipeline ...    (historical batch pipeline)
+CMD ["python", "-m", "reporter_tpu", "serve", "/data/reporter.json", \
+     "0.0.0.0:8002"]

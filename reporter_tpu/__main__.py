@@ -76,6 +76,12 @@ def _graph():
     return main
 
 
+@_cmd("accuracy")
+def _accuracy():
+    from .tools.accuracy_cli import main
+    return main
+
+
 def main(argv=None):
     argv = sys.argv[1:] if argv is None else argv
     if not argv or argv[0] in ("-h", "--help"):

@@ -110,11 +110,22 @@ class RouteCache:
 
 def route_distance(net: RoadNetwork, edge_a: int, offset_a: float,
                    edge_b: int, offset_b: float, max_dist: float,
-                   cache: Optional[RouteCache] = None) -> float:
+                   cache: Optional[RouteCache] = None,
+                   backward_tolerance_m: float = 0.0) -> float:
     """Network distance from a point ``offset_a`` along ``edge_a`` to a point
-    ``offset_b`` along ``edge_b``; UNREACHABLE beyond ``max_dist``."""
+    ``offset_b`` along ``edge_b``; UNREACHABLE beyond ``max_dist``.
+
+    ``backward_tolerance_m`` forgives small *apparent* backward movement on
+    the same directed edge (along-track GPS noise): without it a few meters
+    of backward jitter prices the same-edge transition as a full loop around
+    the block, which makes a one-point flicker onto the co-located reverse
+    edge the cheaper Viterbi path — exactly the segment-flapping the matcher
+    must not emit.
+    """
     if edge_a == edge_b and offset_b >= offset_a:
         return offset_b - offset_a
+    if edge_a == edge_b and offset_a - offset_b <= backward_tolerance_m:
+        return 0.0
     remaining = float(net.edge_length_m[edge_a]) - offset_a
     via = remaining + offset_b
     if via > max_dist:
@@ -136,7 +147,8 @@ def candidate_route_matrices(net: RoadNetwork, cands: CandidateSet,
                              gc_dist: np.ndarray,
                              max_route_distance_factor: float = 5.0,
                              min_bound_m: float = 500.0,
-                             cache: Optional[RouteCache] = None) -> np.ndarray:
+                             cache: Optional[RouteCache] = None,
+                             backward_tolerance_m: float = 0.0) -> np.ndarray:
     """(T-1, K, K) route-distance tensor between consecutive candidates.
 
     ``gc_dist`` is the (T-1,) great-circle distance between consecutive
@@ -160,5 +172,7 @@ def candidate_route_matrices(net: RoadNetwork, cands: CandidateSet,
                 if eb == PAD_EDGE:
                     continue
                 ob = float(cands.offset_m[t + 1, j])
-                out[t, i, j] = route_distance(net, ea, oa, eb, ob, bound, cache)
+                out[t, i, j] = route_distance(
+                    net, ea, oa, eb, ob, bound, cache,
+                    backward_tolerance_m=backward_tolerance_m)
     return out

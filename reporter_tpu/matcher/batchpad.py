@@ -113,12 +113,14 @@ def prepare_trace(net: RoadNetwork, grid: SpatialGrid | None,
     if runtime is not None:
         route = runtime.route_matrices(
             cands, gc,
-            max_route_distance_factor=params.max_route_distance_factor)
+            max_route_distance_factor=params.max_route_distance_factor,
+            backward_tolerance_m=params.backward_tolerance_m)
     else:
         route = candidate_route_matrices(
             net, cands, gc,
             max_route_distance_factor=params.max_route_distance_factor,
-            cache=cache)
+            cache=cache,
+            backward_tolerance_m=params.backward_tolerance_m)
 
     # case codes over kept points: RESTART at the first point and after
     # breakage-sized gaps; SKIP only in the padding tail

@@ -25,6 +25,11 @@ class MatchParams:
     # HMM and interpolated onto the decoded path afterwards — Meili's cure
     # for GPS jitter flipping the matched direction of travel
     interpolation_distance: float = 10.0
+    # apparent backward movement along the same directed edge up to this
+    # many meters is priced as staying put rather than as a loop around the
+    # block; suppresses one-point flickers onto the co-located reverse edge
+    # (see graph/route.py route_distance)
+    backward_tolerance_m: float = 25.0
 
     def with_options(self, options: dict) -> "MatchParams":
         """Apply per-request ``match_options`` overrides by reference name

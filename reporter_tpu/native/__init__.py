@@ -82,7 +82,7 @@ def _get_lib() -> Optional[ctypes.CDLL]:
             ctypes.c_double, c_i32p, c_f32p, c_f32p, c_f32p, c_f32p]
         lib.rt_route_matrices.argtypes = [
             ctypes.c_void_p, ctypes.c_int64, ctypes.c_int32, c_i32p, c_f32p,
-            c_f32p, ctypes.c_double, ctypes.c_double, c_f32p]
+            c_f32p, ctypes.c_double, ctypes.c_double, ctypes.c_double, c_f32p]
         c_i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
         c_u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
         i64ref = ctypes.POINTER(ctypes.c_int64)
@@ -199,7 +199,8 @@ class NativeRuntime:
     # -- candidate_route_matrices-compatible -------------------------------
     def route_matrices(self, cands, gc_dist,
                        max_route_distance_factor: float = 5.0,
-                       min_bound_m: float = 500.0) -> np.ndarray:
+                       min_bound_m: float = 500.0,
+                       backward_tolerance_m: float = 0.0) -> np.ndarray:
         T, K = cands.edge_ids.shape
         out = np.empty((max(T - 1, 0), K, K), dtype=np.float32)
         if T < 2:
@@ -209,7 +210,8 @@ class NativeRuntime:
         gc = np.ascontiguousarray(gc_dist, dtype=np.float32)
         self._lib.rt_route_matrices(
             self._handle, T, K, edge, off, gc,
-            float(max_route_distance_factor), float(min_bound_m), out)
+            float(max_route_distance_factor), float(min_bound_m),
+            float(backward_tolerance_m), out)
         return out
 
     def cache_clear(self):

@@ -232,7 +232,7 @@ void rt_candidates(void* handle, int64_t n_points, const double* px,
 void rt_route_matrices(void* handle, int64_t T, int32_t K,
                        const int32_t* edge_ids, const float* offsets,
                        const float* gc, double factor, double min_bound,
-                       float* out) {
+                       double backward_tol, float* out) {
   auto* g = static_cast<Graph*>(handle);
   // serialise cache access; candidate lookup stays lock-free (read-only)
   std::lock_guard<std::mutex> lock(g->route_mu);
@@ -260,6 +260,12 @@ void rt_route_matrices(void* handle, int64_t T, int32_t K,
         const float ob = offsets[(t + 1) * K + j];
         if (eb == ea && ob >= oa) {
           row[j] = ob - oa;
+          continue;
+        }
+        // forgive small apparent backward movement on the same directed
+        // edge (along-track GPS noise) — see graph/route.py route_distance
+        if (eb == ea && oa - ob <= backward_tol) {
+          row[j] = 0.0f;
           continue;
         }
         const float via = remaining + ob;

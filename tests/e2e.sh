@@ -1,0 +1,68 @@
+#!/usr/bin/env bash
+# End-to-end replay test: synthesise a city + raw probe data, replay it
+# through the streaming worker (formatter -> batcher -> in-process TPU
+# matcher -> anonymiser), and assert tiles land on disk.
+#
+# Equivalent of the reference's integration test (tests/circle.sh:26-113),
+# with the docker/kafka/S3 scaffolding replaced by the in-process topology:
+# same data path, same asserts — >=1 "Writing tile to" log line, log-line
+# count == tile-file count, and every logged tile path exists
+# (circle.sh:94-113). Runs anywhere python + the package run; no services.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+. tests/env.sh
+
+WORK=$(mktemp -d)
+trap 'rm -rf "${WORK}"' EXIT
+RESULTS="${WORK}/results"
+
+echo "[e2e] building synthetic city graph"
+python -m reporter_tpu graph build-synth --rows 12 --cols 12 \
+    --spacing-m 200 --seed 7 --out "${WORK}/city.npz"
+
+echo "[e2e] synthesising raw sv probe data"
+python -m reporter_tpu synth --graph "${WORK}/city.npz" --traces 8 \
+    --noise-m 4 --seed 3 --format sv > "${WORK}/raw.sv"
+N_LINES=$(wc -l < "${WORK}/raw.sv")
+[ "${N_LINES}" -gt 0 ] || { echo "[e2e] FAIL: no raw data"; exit 1; }
+echo "[e2e] ${N_LINES} raw probe points"
+
+echo "[e2e] replaying through the streaming worker"
+# privacy 1 / quantisation 3600 / flush 15 mirror circle.sh's
+# `reporter-kafka -p 1 -q 3600 -i 15` invocation (circle.sh:58-66)
+python -m reporter_tpu stream -f "${FORMATTER}" --graph "${WORK}/city.npz" \
+    -r "${REPORT_LEVELS}" -x "${TRANSITION_LEVELS}" \
+    -p 1 -q 3600 -i 15 -s e2e -o "${RESULTS}" \
+    --input "${WORK}/raw.sv" 2> "${WORK}/worker.log" || {
+  echo "[e2e] FAIL: worker exited nonzero"; cat "${WORK}/worker.log"; exit 1; }
+
+# -- asserts (circle.sh:94-113) -------------------------------------------
+WRITES=$(grep -c "Writing tile to" "${WORK}/worker.log" || true)
+if [ "${WRITES}" -lt 1 ]; then
+  echo "[e2e] FAIL: no tiles were written"; cat "${WORK}/worker.log"; exit 1
+fi
+
+FILES=$(find "${RESULTS}" -type f | wc -l)
+if [ "${WRITES}" -ne "${FILES}" ]; then
+  echo "[e2e] FAIL: ${WRITES} tile writes logged but ${FILES} files found"
+  exit 1
+fi
+
+# every logged tile path exists: log format is
+# "Writing tile to <output>/<time_range>/<level>/<index>/<file> with N segments"
+grep "Writing tile to" "${WORK}/worker.log" | \
+  sed -e 's/.*Writing tile to //' -e 's/ with.*//' | \
+  while read -r TILE_PATH; do
+    if [ ! -f "${TILE_PATH}" ]; then
+      echo "[e2e] FAIL: logged tile ${TILE_PATH} has no file"; exit 1
+    fi
+  done
+
+# tile CSVs carry the reference's column layout (Segment.java:55-57)
+HEADER=$(find "${RESULTS}" -type f | head -1 | xargs head -1)
+case "${HEADER}" in
+  segment_id,*) : ;;
+  *) echo "[e2e] FAIL: bad tile header: ${HEADER}"; exit 1 ;;
+esac
+
+echo "[e2e] PASS: ${WRITES} tiles written and verified"

@@ -1,0 +1,10 @@
+# Shared environment for the shell test harnesses
+# (equivalent of reference tests/env.sh:1-9).
+export REPORTER_HOST=${REPORTER_HOST:-localhost}
+export REPORTER_PORT=${REPORTER_PORT:-8002}
+export REPORTER_URL=${REPORTER_URL:-http://${REPORTER_HOST}:${REPORTER_PORT}/report}
+# synth sv layout: uuid|lat|lon|time|accuracy (tools/synth_cli.py emit_sv)
+export FORMATTER=${FORMATTER:-',sv,\|,0,1,2,3,4'}
+export REPORT_LEVELS=${REPORT_LEVELS:-0,1,2}
+export TRANSITION_LEVELS=${TRANSITION_LEVELS:-0,1,2}
+export THRESHOLD_SEC=${THRESHOLD_SEC:-15}

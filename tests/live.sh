@@ -1,0 +1,60 @@
+#!/usr/bin/env bash
+# Live smoke test: fire canned /report requests at a running service,
+# 4-way parallel, fail-fast (equivalent of reference tests/live.sh:21-32).
+#
+# Usage: REPORTER_URL=http://host:8002/report tests/live.sh [graph.npz]
+# With a graph argument, request bodies are synthesised against that graph
+# so segment ids actually resolve; otherwise the default synthetic city
+# matching `python -m reporter_tpu serve` on a build-synth config is used.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+. tests/env.sh
+
+WORK=$(mktemp -d)
+trap 'rm -rf "${WORK}"' EXIT
+
+GRAPH_ARGS=()
+if [ "$#" -ge 1 ]; then GRAPH_ARGS=(--graph "$1"); fi
+
+echo "[live] synthesising canned request bodies"
+python -m reporter_tpu synth "${GRAPH_ARGS[@]}" --traces 8 --seed 11 \
+    --format json > "${WORK}/bodies.jsonl"
+
+post_one() {
+  # curl-equivalent in stdlib python: POST one body, require HTTP 200 and
+  # a datastore block in the response
+  python - "$1" <<'EOF'
+import json, sys, urllib.request
+body = sys.argv[1].encode()
+req = urllib.request.Request(
+    __import__("os").environ["REPORTER_URL"], data=body,
+    headers={"Content-Type": "application/json"})
+with urllib.request.urlopen(req, timeout=180) as resp:
+    assert resp.status == 200, resp.status
+    out = json.loads(resp.read())
+assert "datastore" in out, out
+EOF
+}
+
+# warm the service (first request pays XLA compile, ~20-40s on TPU)
+echo "[live] warmup request"
+post_one "$(head -1 "${WORK}/bodies.jsonl")"
+
+echo "[live] POSTing to ${REPORTER_URL} (4-way parallel, fail-fast)"
+
+FAIL=0
+PIDS=()
+while IFS= read -r BODY; do
+  post_one "${BODY}" &
+  PIDS+=("$!")
+  if [ "${#PIDS[@]}" -ge 4 ]; then
+    for PID in "${PIDS[@]}"; do wait "${PID}" || FAIL=1; done
+    PIDS=()
+    [ "${FAIL}" -eq 0 ] || { echo "[live] FAIL"; exit 1; }
+  fi
+done < "${WORK}/bodies.jsonl"
+for PID in "${PIDS[@]:-}"; do
+  if [ -n "${PID}" ]; then wait "${PID}" || FAIL=1; fi
+done
+[ "${FAIL}" -eq 0 ] || { echo "[live] FAIL"; exit 1; }
+echo "[live] PASS"

@@ -78,6 +78,20 @@ class TestRoute:
         d = route_distance(city, 0, 50.0, nxt, 30.0, max_dist=1000.0)
         assert d == pytest.approx((200.0 - 50.0) + 30.0)
 
+    def test_same_edge_backward_loops_by_default(self, city):
+        # backward on a directed edge = loop around; far more than the jitter
+        d = route_distance(city, 3, 150.0, 3, 140.0, max_dist=5000.0)
+        assert d > 100.0
+
+    def test_same_edge_backward_within_tolerance_is_free(self, city):
+        d = route_distance(city, 3, 150.0, 3, 140.0, max_dist=5000.0,
+                           backward_tolerance_m=25.0)
+        assert d == 0.0
+        # beyond the tolerance the loop price comes back
+        d = route_distance(city, 3, 150.0, 3, 100.0, max_dist=5000.0,
+                           backward_tolerance_m=25.0)
+        assert d > 100.0
+
     def test_unreachable_when_bounded(self, city):
         # far corner beyond a tiny bound
         d = route_distance(city, 0, 0.0, city.num_edges - 1, 0.0, max_dist=100.0)

@@ -57,6 +57,13 @@ def test_route_matrices_match_numpy(city, runtime, trace):
     np.testing.assert_array_equal(m_cc >= 0.5e9, m_np >= 0.5e9)
     reachable = m_np < 0.5e9
     np.testing.assert_allclose(m_cc[reachable], m_np[reachable], atol=0.5)
+    # with the backward tolerance the two backends still agree
+    m_np = candidate_route_matrices(city, cands, gc, cache=RouteCache(city),
+                                    backward_tolerance_m=25.0)
+    m_cc = runtime.route_matrices(cands, gc, backward_tolerance_m=25.0)
+    np.testing.assert_array_equal(m_cc >= 0.5e9, m_np >= 0.5e9)
+    reachable = m_np < 0.5e9
+    np.testing.assert_allclose(m_cc[reachable], m_np[reachable], atol=0.5)
 
 
 def test_cache_grows_and_clears(city, runtime, trace):

@@ -147,3 +147,21 @@ class TestSynthCli:
         assert body["uuid"] == "synth-0"
         assert len(body["trace"]) >= 2
         assert body["match_options"]["report_levels"] == [0, 1]
+
+
+class TestAccuracyCli:
+    def test_gate_passes_on_clean_city(self, capsys):
+        from reporter_tpu.tools.accuracy_cli import main
+        assert main(["--traces", "8", "--rows", "10", "--cols", "10",
+                     "--noise-m", "3.0", "--min-agreement", "0.99"]) == 0
+        out = json.loads(capsys.readouterr().out)
+        assert out["traces"] == 8
+        assert out["agreement"] >= 0.99
+        assert out["segment_precision"] >= 0.99
+        assert 0.9 <= out["point_agreement"] <= 1.0
+
+    def test_gate_fails_below_threshold(self, capsys):
+        from reporter_tpu.tools.accuracy_cli import main
+        # an impossible bar guarantees the failure path
+        assert main(["--traces", "4", "--rows", "8", "--cols", "8",
+                     "--noise-m", "12.0", "--min-agreement", "1.01"]) == 1
