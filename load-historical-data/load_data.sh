@@ -1,0 +1,39 @@
+#!/usr/bin/env bash
+# Backfill ONE day of historical probe data through the batch pipeline
+# (equivalent of the reference's load_data.sh:1-13, which ran
+# simple_reporter.py with concurrency 16 over a day's S3 prefix).
+#
+# Usage: ./load_data.sh YYYY-MM-DD SRC_PREFIX DEST [DATA_DIR]
+#   SRC_PREFIX  s3://bucket/prefix or a local directory of part files;
+#               the day is appended as .../YYYY/MM/DD
+#   DEST        s3://bucket[/prefix] or a local output directory
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+DAY="${1:?usage: load_data.sh YYYY-MM-DD SRC_PREFIX DEST [DATA_DIR] [extra pipeline flags]}"
+SRC="${2:?need SRC_PREFIX}"
+DEST="${3:?need DEST}"
+shift 3
+DATA_DIR="/data"
+if [ "$#" -ge 1 ] && [ "${1#--}" = "${1}" ]; then
+  DATA_DIR="$1"
+  shift
+fi
+
+DAY_PATH="$(echo "${DAY}" | tr - /)"
+
+# concurrency drives stages 1+3 (host process fan-out); stage 2 batches
+# --device-batch traces per TPU dispatch. To RESUME a failed day from its
+# intermediate outputs, append --trace-dir <dir> (skips the download
+# stage) or --match-dir <dir> (skips download + match) using the scratch
+# paths the failed run logged.
+python -m reporter_tpu pipeline \
+    --src "${SRC}/${DAY_PATH}" \
+    --match-config "${DATA_DIR}/reporter.json" \
+    --dest "${DEST}" \
+    --report-levels 0,1,2 --transition-levels 0,1,2 \
+    --quantisation 3600 --privacy 2 --inactivity 120 \
+    --concurrency "${CONCURRENCY:-16}" \
+    --device-batch "${DEVICE_BATCH:-512}" \
+    --source-id "backfill_${DAY}" \
+    "$@"

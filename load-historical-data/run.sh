@@ -1,0 +1,25 @@
+#!/usr/bin/env bash
+# Backfill a RANGE of days, one load_data.sh invocation per day
+# (equivalent of the reference's run.sh:1-7, which listed per-day
+# simple_reporter commands by hand).
+#
+# Usage: ./run.sh FIRST_DAY LAST_DAY SRC_PREFIX DEST [DATA_DIR]
+set -euo pipefail
+cd "$(dirname "$0")"
+
+FIRST="${1:?usage: run.sh FIRST_DAY LAST_DAY SRC_PREFIX DEST [DATA_DIR]}"
+LAST="${2:?need LAST_DAY}"
+SRC="${3:?need SRC_PREFIX}"
+DEST="${4:?need DEST}"
+DATA_DIR="${5:-/data}"
+
+# ordinal comparison (not string equality) so an unpadded or reversed
+# range terminates instead of looping past the end date
+LAST_TS="$(date -u -d "${LAST}" +%s)"
+DAY="$(date -u -d "${FIRST}" +%F)"
+while [ "$(date -u -d "${DAY}" +%s)" -le "${LAST_TS}" ]; do
+  echo "[backfill] ${DAY}"
+  ./load_data.sh "${DAY}" "${SRC}" "${DEST}" "${DATA_DIR}"
+  DAY="$(date -u -d "${DAY} + 1 day" +%F)"
+done
+echo "[backfill] done ${FIRST}..${LAST}"

@@ -1,0 +1,36 @@
+#!/usr/bin/env bash
+# One-time host setup for bulk backfill (equivalent of the reference's
+# load-historical-data/setup.sh:1-58, which apt-installed valhalla and
+# downloaded the planet tile tarball). Here: build the native runtime and
+# materialise a road graph + matcher config under $DATA_DIR.
+#
+# Usage: ./setup.sh [DATA_DIR] ; env GRAPH_SOURCE=<.npz|tile-dir> to use a
+# real graph instead of the synthetic default.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+DATA_DIR="${1:-/data}"
+mkdir -p "${DATA_DIR}"
+
+echo "[setup] building native host runtime"
+make -C reporter_tpu/native
+
+GRAPH="${DATA_DIR}/graph.npz"
+if [ -n "${GRAPH_SOURCE:-}" ]; then
+  if [ -d "${GRAPH_SOURCE}" ]; then
+    echo "[setup] composing graph from tile tree ${GRAPH_SOURCE}"
+    python -m reporter_tpu graph untile --tile-dir "${GRAPH_SOURCE}" \
+        --out "${GRAPH}"
+  else
+    echo "[setup] using graph ${GRAPH_SOURCE}"
+    cp "${GRAPH_SOURCE}" "${GRAPH}"
+  fi
+else
+  echo "[setup] no GRAPH_SOURCE; generating a synthetic city graph"
+  python -m reporter_tpu graph build-synth --rows 24 --cols 24 \
+      --spacing-m 200 --seed 0 --out "${GRAPH}"
+fi
+
+printf '{"graph": "%s"}\n' "${GRAPH}" > "${DATA_DIR}/reporter.json"
+python -m reporter_tpu graph info "${GRAPH}"
+echo "[setup] done: ${DATA_DIR}/reporter.json"

@@ -423,23 +423,32 @@ def main(argv=None):
 
     from ..matcher import Configure, SegmentMatcher
 
+    from ..utils import metrics
+
     trace_dir = args.trace_dir
     match_dir = args.match_dir
     if not trace_dir and not match_dir:
         if not args.src:
             parser.error("--src is required unless resuming")
-        trace_dir = gather_traces(args.src, args.src_key_regex,
-                                  args.src_valuer, args.src_time_pattern,
-                                  args.bbox, args.concurrency)
+        with metrics.timer("pipeline.gather"):
+            trace_dir = gather_traces(args.src, args.src_key_regex,
+                                      args.src_valuer, args.src_time_pattern,
+                                      args.bbox, args.concurrency)
     if not match_dir:
         Configure(args.match_config)
         matcher = SegmentMatcher()
-        match_dir = match_traces(
-            trace_dir, matcher, args.mode, args.report_levels,
-            args.transition_levels, args.quantisation, args.inactivity,
-            args.source_id, device_batch=args.device_batch)
+        with metrics.timer("pipeline.match"):
+            match_dir = match_traces(
+                trace_dir, matcher, args.mode, args.report_levels,
+                args.transition_levels, args.quantisation, args.inactivity,
+                args.source_id, device_batch=args.device_batch)
     if args.dest:
-        report_tiles(match_dir, args.dest, args.privacy, args.concurrency)
+        with metrics.timer("pipeline.report"):
+            report_tiles(match_dir, args.dest, args.privacy, args.concurrency)
+    timers = metrics.snapshot()["timers"]
+    logging.info("Stage timings: %s", {
+        k: v["total_s"] for k, v in timers.items()
+        if k.startswith("pipeline.")})
     if args.cleanup:
         for d in (trace_dir, match_dir):
             if d and not (d == args.trace_dir or d == args.match_dir):

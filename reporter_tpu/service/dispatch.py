@@ -17,6 +17,8 @@ import threading
 import time
 from typing import Callable, List, Optional, Sequence
 
+from ..utils import metrics
+
 
 class _Slot:
     __slots__ = ("trace", "event", "result", "error")
@@ -77,11 +79,15 @@ class BatchDispatcher:
     def _loop(self):
         while not self._closed:
             slots = self._drain_batch()
+            metrics.count("dispatch.batches")
+            metrics.count("dispatch.traces", len(slots))
             try:
-                results = self._match_many([s.trace for s in slots])
+                with metrics.timer("dispatch.match_many"):
+                    results = self._match_many([s.trace for s in slots])
                 for slot, res in zip(slots, results):
                     slot.result = res
             except Exception as e:  # propagate to every waiter in the batch
+                metrics.count("dispatch.errors")
                 for slot in slots:
                     slot.error = e
             finally:

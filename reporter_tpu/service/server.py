@@ -30,10 +30,13 @@ import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from ..matcher import Configure, SegmentMatcher
+from ..utils import metrics
 from .dispatch import BatchDispatcher
 from .report import report
 
-ACTIONS = {"report"}
+# /report is the reference's only action (reporter_service.py:26);
+# /stats is new — a metrics snapshot (counters + stage timers)
+ACTIONS = {"report", "stats"}
 
 
 class ReporterService:
@@ -109,12 +112,20 @@ def make_handler(service: ReporterService):
             self.wfile.write(raw)
 
         def _do(self, post: bool):
+            action = urllib.parse.urlsplit(self.path).path.split("/")[-1]
+            if action == "stats":
+                self._respond(200, json.dumps(metrics.snapshot()))
+                return
             try:
                 trace = self._parse(post)
             except Exception as e:
                 self._respond(400, json.dumps({"error": str(e)}))
                 return
-            code, body = service.handle(trace)
+            metrics.count("service.requests")
+            with metrics.timer("service.handle"):
+                code, body = service.handle(trace)
+            if code != 200:
+                metrics.count(f"service.errors.{code}")
             self._respond(code, body)
 
         def do_GET(self):

@@ -2,5 +2,7 @@ from .formatter import Formatter
 from .batcher import Batch, PointBatcher
 from .anonymiser import Anonymiser
 from .broker import InMemoryBroker
+from .state import StateStore
 
-__all__ = ["Formatter", "Batch", "PointBatcher", "Anonymiser", "InMemoryBroker"]
+__all__ = ["Formatter", "Batch", "PointBatcher", "Anonymiser",
+           "InMemoryBroker", "StateStore"]

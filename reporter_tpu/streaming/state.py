@@ -1,0 +1,191 @@
+"""Durable streaming state: snapshot/restore for batcher + anonymiser.
+
+The reference's streaming worker keeps all state in **in-memory** Kafka
+Streams stores — explicitly not RocksDB — so a crash loses every open
+per-uuid batch and every accumulated tile slice
+(reference: BatchingProcessor.java:20-22, AnonymisingProcessor.java:47-59).
+SURVEY.md §5 flags that as the durability decision to improve on.
+
+This module is the improvement: a periodic, atomic, binary snapshot of
+the worker's two state stores, restored on startup. The wire layouts are
+the framework's own fixed-width serdes (Point 20 B, Segment 40 B,
+TimeQuantisedTile 16 B — core/types.py), so the snapshot stays compact
+and the serde code paths get exercised in production. Writes go to a tmp
+file then ``os.replace`` so a crash mid-write leaves the previous
+snapshot intact; restore of a truncated/corrupt file is treated as "no
+snapshot" (the reference's crash semantics) rather than an error.
+
+Layout (little-endian, "RTS1" magic):
+
+  header:  4s magic | u32 version | u64 snapshot_unix_ms
+  batches: u32 count, then per uuid:
+           u16 uuid_len | uuid utf-8 | f32 max_separation |
+           u64 last_update_ms | u32 n_points | n_points * Point
+  slices:  u32 count, then per slice:
+           u16 name_len | name utf-8 | u32 n_segments | n * Segment
+  slice_of: u32 count, then per tile: Tile | u32 slice_no
+"""
+from __future__ import annotations
+
+import logging
+import os
+import struct
+import time
+from typing import Optional
+
+from ..core.types import Point, Segment, TimeQuantisedTile
+from .batcher import Batch, PointBatcher
+from .anonymiser import Anonymiser
+
+logger = logging.getLogger("reporter_tpu.streaming")
+
+_MAGIC = b"RTS1"
+_VERSION = 1
+_HEADER = struct.Struct("<4sIQ")
+_U16 = struct.Struct("<H")
+_U32 = struct.Struct("<I")
+_BATCH_META = struct.Struct("<fQI")
+
+
+def _pack_str(out: bytearray, s: str) -> None:
+    raw = s.encode("utf-8")
+    out += _U16.pack(len(raw))
+    out += raw
+
+
+class _Reader:
+    def __init__(self, raw: bytes):
+        self.raw = raw
+        self.pos = 0
+
+    def take(self, n: int) -> bytes:
+        if self.pos + n > len(self.raw):
+            raise ValueError("truncated snapshot")
+        chunk = self.raw[self.pos:self.pos + n]
+        self.pos += n
+        return chunk
+
+    def u16(self) -> int:
+        return _U16.unpack(self.take(2))[0]
+
+    def u32(self) -> int:
+        return _U32.unpack(self.take(4))[0]
+
+    def string(self) -> str:
+        return self.take(self.u16()).decode("utf-8")
+
+
+def snapshot_bytes(batcher: PointBatcher, anonymiser: Anonymiser) -> bytes:
+    out = bytearray()
+    out += _HEADER.pack(_MAGIC, _VERSION, int(time.time() * 1000))
+
+    out += _U32.pack(len(batcher.store))
+    for uuid, batch in batcher.store.items():
+        _pack_str(out, uuid)
+        out += _BATCH_META.pack(batch.max_separation, batch.last_update,
+                                len(batch.points))
+        for p in batch.points:
+            out += p.to_bytes()
+
+    out += _U32.pack(len(anonymiser.slices))
+    for name, segments in anonymiser.slices.items():
+        _pack_str(out, name)
+        out += _U32.pack(len(segments))
+        for s in segments:
+            out += s.to_bytes()
+
+    out += _U32.pack(len(anonymiser.slice_of))
+    for tile, slice_no in anonymiser.slice_of.items():
+        out += tile.to_bytes()
+        out += _U32.pack(slice_no)
+    return bytes(out)
+
+
+def restore_bytes(raw: bytes, batcher: PointBatcher,
+                  anonymiser: Anonymiser) -> None:
+    """Populate the two stores from a snapshot. Raises ValueError on a
+    corrupt/truncated snapshot — in that case the stores are left
+    UNTOUCHED (the whole snapshot is parsed before anything is applied),
+    so callers can safely treat the failure as "no snapshot"."""
+    r = _Reader(raw)
+    magic, version, _ts = _HEADER.unpack(r.take(_HEADER.size))
+    if magic != _MAGIC or version != _VERSION:
+        raise ValueError(f"bad snapshot header {magic!r} v{version}")
+
+    store = {}
+    for _ in range(r.u32()):
+        uuid = r.string()
+        max_sep, last_update, n_points = _BATCH_META.unpack(
+            r.take(_BATCH_META.size))
+        batch = Batch()
+        batch.max_separation = max_sep
+        batch.last_update = last_update
+        for _ in range(n_points):
+            batch.points.append(Point.from_bytes(r.take(Point.SIZE)))
+        store[uuid] = batch
+
+    slices = {}
+    for _ in range(r.u32()):
+        name = r.string()
+        slices[name] = [Segment.from_bytes(r.take(Segment.SIZE))
+                        for _ in range(r.u32())]
+
+    slice_of = {}
+    for _ in range(r.u32()):
+        tile = TimeQuantisedTile.from_bytes(r.take(TimeQuantisedTile.SIZE))
+        slice_of[tile] = r.u32()
+
+    # parse succeeded in full — apply atomically
+    batcher.store.update(store)
+    anonymiser.slices.update(slices)
+    anonymiser.slice_of.update(slice_of)
+
+
+class StateStore:
+    """Owns the snapshot file; periodic save + startup restore.
+
+    ``interval_s`` bounds the replay window after a crash: at most that
+    many seconds of stream go unsnapshotted (the reference loses
+    *everything* open on crash instead).
+    """
+
+    def __init__(self, path: str, interval_s: float = 30.0,
+                 clock=time.time):
+        self.path = path
+        self.interval_s = interval_s
+        self.clock = clock
+        self._last_save = clock()
+        parent = os.path.dirname(os.path.abspath(path))
+        os.makedirs(parent, exist_ok=True)
+
+    def restore(self, batcher: PointBatcher,
+                anonymiser: Anonymiser) -> bool:
+        """Load state if a snapshot exists; False when starting fresh."""
+        try:
+            with open(self.path, "rb") as f:
+                raw = f.read()
+        except FileNotFoundError:
+            return False
+        try:
+            restore_bytes(raw, batcher, anonymiser)
+        except ValueError as e:
+            logger.error("Discarding corrupt state snapshot %s: %s",
+                         self.path, e)
+            return False
+        logger.info("Restored state: %d open batches, %d tile slices",
+                    len(batcher.store), len(anonymiser.slices))
+        return True
+
+    def save(self, batcher: PointBatcher, anonymiser: Anonymiser) -> None:
+        tmp = self.path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(snapshot_bytes(batcher, anonymiser))
+        os.replace(tmp, self.path)
+        self._last_save = self.clock()
+
+    def maybe_save(self, batcher: PointBatcher,
+                   anonymiser: Anonymiser) -> bool:
+        if self.clock() - self._last_save < self.interval_s:
+            return False
+        self.save(batcher, anonymiser)
+        return True

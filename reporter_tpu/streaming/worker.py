@@ -74,7 +74,8 @@ class StreamWorker:
                  transitions: str = "0,1",
                  flush_interval_s: float = 3600.0,
                  session_gap_ms: int = SESSION_GAP_MS,
-                 clock=time.time):
+                 clock=time.time,
+                 state=None):
         self.formatter = formatter
         self.anonymiser = anonymiser
         self.batcher = PointBatcher(
@@ -88,6 +89,12 @@ class StreamWorker:
         self.parse_failures = 0
         self._last_flush = clock()
         self._last_evict = clock()
+        # durable state (StateStore): restore open batches + tile slices
+        # from the last snapshot — the reference instead loses in-memory
+        # state on crash (BatchingProcessor.java:20-22, SURVEY.md §5)
+        self.state = state
+        self.restored = bool(
+            state and state.restore(self.batcher, self.anonymiser))
 
     def offer(self, message: str) -> None:
         """One raw message through the topology."""
@@ -107,17 +114,29 @@ class StreamWorker:
 
     def maybe_punctuate(self, force: bool = False) -> None:
         now = self.clock()
+        flushed = False
         if force or (now - self._last_evict) * 1000 >= 2 * self.session_gap_ms:
             self.batcher.punctuate(int(now * 1000))
             self._last_evict = now
+            flushed = True
         if force or now - self._last_flush >= self.flush_interval_s:
             self.anonymiser.punctuate()
             self._last_flush = now
+            flushed = True
+        if self.state is not None:
+            if flushed:
+                # tiles just egressed (an external side effect) — snapshot
+                # NOW, else a crash would restore and re-emit them
+                self.state.save(self.batcher, self.anonymiser)
+            else:
+                self.state.maybe_save(self.batcher, self.anonymiser)
 
     def drain(self) -> None:
         """End of stream: evict every open batch and flush all tiles."""
         self.batcher.punctuate(int(self.clock() * 1000) + 10 * self.session_gap_ms)
         self.anonymiser.punctuate()
+        if self.state is not None:
+            self.state.save(self.batcher, self.anonymiser)
 
     def run(self, messages: Iterable[str],
             duration_s: Optional[float] = None) -> None:
@@ -153,6 +172,10 @@ def main(argv=None):
     parser.add_argument("-b", "--bootstrap", help="Kafka bootstrap servers")
     parser.add_argument("-t", "--topics",
                         help="comma-separated topics; first is raw input")
+    parser.add_argument("--state-file",
+                        help="durable state snapshot path; restored on "
+                             "start, saved every --state-interval seconds")
+    parser.add_argument("--state-interval", type=float, default=30.0)
     args = parser.parse_args(argv)
 
     logging.basicConfig(level=logging.INFO,
@@ -170,12 +193,17 @@ def main(argv=None):
             SegmentMatcher(net=RoadNetwork.load(args.graph)))
         submit = inproc_submitter(service)
 
+    state = None
+    if args.state_file:
+        from .state import StateStore
+        state = StateStore(args.state_file, interval_s=args.state_interval)
+
     worker = StreamWorker(
         Formatter.from_config(args.formatter), submit,
         Anonymiser(TileSink(args.output_location), args.privacy,
                    args.quantisation, mode=args.mode, source=args.source),
         mode=args.mode, reports=args.reports, transitions=args.transitions,
-        flush_interval_s=args.flush_interval)
+        flush_interval_s=args.flush_interval, state=state)
 
     if args.bootstrap:
         from .broker import KafkaBroker

@@ -1,0 +1,120 @@
+"""Lightweight metrics: counters, stage timers, optional device profiling.
+
+The reference's only telemetry is a throughput counter logged every 10k
+messages (reference: KeyedFormattingProcessor.java:36-38,
+cat_to_kafka.py:59-61) and the per-trace stats block in the /report
+response (reporter_service.py:164-177). SURVEY.md §5 lists
+tracing/profiling as an absent subsystem to build fresh.
+
+This module is that subsystem, kept deliberately small and lock-cheap:
+
+- ``Registry``: named monotonically-increasing counters and accumulating
+  timers (count / total seconds / max seconds), snapshot-able as a dict
+  for logs or a /stats endpoint.
+- ``timer(name)``: context manager recording a stage duration.
+- ``device_trace(out_dir)``: context manager wrapping
+  ``jax.profiler.trace`` — a real TPU trace viewable in TensorBoard
+  or Perfetto — gated so importing this module never imports jax.
+
+All state lives in a process-global default registry (``metrics.default``)
+because every consumer in this framework is process-wide (one matcher, one
+dispatcher); tests construct private ``Registry`` instances.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Dict, Iterator
+
+
+class _Timer:
+    __slots__ = ("count", "total_s", "max_s")
+
+    def __init__(self):
+        self.count = 0
+        self.total_s = 0.0
+        self.max_s = 0.0
+
+    def add(self, elapsed_s: float) -> None:
+        self.count += 1
+        self.total_s += elapsed_s
+        if elapsed_s > self.max_s:
+            self.max_s = elapsed_s
+
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[str, int] = {}
+        self._timers: Dict[str, _Timer] = {}
+
+    def count(self, name: str, n: int = 1) -> int:
+        """Increment a counter; returns the new value."""
+        with self._lock:
+            v = self._counters.get(name, 0) + n
+            self._counters[name] = v
+            return v
+
+    @contextlib.contextmanager
+    def timer(self, name: str) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            elapsed = time.perf_counter() - t0
+            with self._lock:
+                t = self._timers.get(name)
+                if t is None:
+                    t = self._timers[name] = _Timer()
+                t.add(elapsed)
+
+    def observe(self, name: str, elapsed_s: float) -> None:
+        """Record a duration measured externally."""
+        with self._lock:
+            t = self._timers.get(name)
+            if t is None:
+                t = self._timers[name] = _Timer()
+            t.add(elapsed_s)
+
+    def snapshot(self) -> dict:
+        """{"counters": {...}, "timers": {name: {count,total_s,mean_s,max_s}}}"""
+        with self._lock:
+            counters = dict(self._counters)
+            timers = {
+                name: {
+                    "count": t.count,
+                    "total_s": round(t.total_s, 6),
+                    "mean_s": round(t.total_s / t.count, 6) if t.count else 0.0,
+                    "max_s": round(t.max_s, 6),
+                }
+                for name, t in self._timers.items()
+            }
+        return {"counters": counters, "timers": timers}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._timers.clear()
+
+
+#: process-global registry used by the service/worker/pipeline
+default = Registry()
+count = default.count
+timer = default.timer
+observe = default.observe
+snapshot = default.snapshot
+
+
+@contextlib.contextmanager
+def device_trace(out_dir: str) -> Iterator[None]:
+    """Capture an XLA/TPU profiler trace into ``out_dir`` (view with
+    TensorBoard's profile plugin or Perfetto). A no-op context if jax is
+    unavailable."""
+    try:
+        import jax
+    except ImportError:  # pragma: no cover - jax is baked into this image
+        yield
+        return
+    with jax.profiler.trace(out_dir):
+        yield

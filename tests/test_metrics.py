@@ -1,0 +1,73 @@
+"""Metrics registry + /stats endpoint (the tracing/profiling subsystem
+SURVEY.md §5 lists as absent in the reference and built fresh here)."""
+import threading
+import time
+
+from reporter_tpu.utils.metrics import Registry, device_trace
+
+
+class TestRegistry:
+    def test_counters_accumulate(self):
+        r = Registry()
+        assert r.count("a") == 1
+        assert r.count("a", 5) == 6
+        assert r.count("b") == 1
+        assert r.snapshot()["counters"] == {"a": 6, "b": 1}
+
+    def test_timer_records_count_total_max(self):
+        r = Registry()
+        with r.timer("stage"):
+            time.sleep(0.01)
+        with r.timer("stage"):
+            pass
+        t = r.snapshot()["timers"]["stage"]
+        assert t["count"] == 2
+        assert t["total_s"] >= 0.01
+        assert t["max_s"] >= 0.01
+        assert t["mean_s"] <= t["max_s"]
+
+    def test_timer_records_on_exception(self):
+        r = Registry()
+        try:
+            with r.timer("boom"):
+                raise ValueError
+        except ValueError:
+            pass
+        assert r.snapshot()["timers"]["boom"]["count"] == 1
+
+    def test_observe_external_duration(self):
+        r = Registry()
+        r.observe("x", 1.5)
+        assert r.snapshot()["timers"]["x"]["total_s"] == 1.5
+
+    def test_thread_safety(self):
+        r = Registry()
+
+        def work():
+            for _ in range(1000):
+                r.count("n")
+
+        threads = [threading.Thread(target=work) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert r.snapshot()["counters"]["n"] == 8000
+
+    def test_reset(self):
+        r = Registry()
+        r.count("a")
+        r.observe("t", 1.0)
+        r.reset()
+        assert r.snapshot() == {"counters": {}, "timers": {}}
+
+
+class TestDeviceTrace:
+    def test_trace_context_produces_profile(self, tmp_path):
+        import jax
+        import jax.numpy as jnp
+        with device_trace(str(tmp_path)):
+            jnp.ones(8).sum().block_until_ready()
+        # jax writes trace events under plugins/profile/<run>/
+        produced = list(tmp_path.rglob("*"))
+        assert produced, "no profiler output written"

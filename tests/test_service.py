@@ -105,3 +105,13 @@ class TestService:
         # sanity: each has a stats block and parseable reports
         for _, body in results:
             assert "stats" in body and "datastore" in body
+
+    def test_stats_endpoint(self, city, server):
+        # make sure at least one request has been counted
+        req = make_req(city, 6)
+        post(f"{server}/report", req)
+        code, body = get(f"{server}/stats")
+        assert code == 200
+        assert body["counters"]["service.requests"] >= 1
+        assert body["counters"]["dispatch.traces"] >= 1
+        assert body["timers"]["dispatch.match_many"]["count"] >= 1

@@ -1,0 +1,158 @@
+"""Durable streaming state: snapshot/restore roundtrip, corruption
+handling, and crash-resume through the full worker topology (the
+durability upgrade over the reference's in-memory-only state stores,
+reference: BatchingProcessor.java:20-22, AnonymisingProcessor.java:47-59)."""
+import numpy as np
+import pytest
+
+from reporter_tpu.core.types import Point, Segment
+from reporter_tpu.streaming.anonymiser import Anonymiser, TileSink
+from reporter_tpu.streaming.batcher import Batch, PointBatcher
+from reporter_tpu.streaming.state import (StateStore, restore_bytes,
+                                          snapshot_bytes)
+
+
+def _batcher():
+    return PointBatcher(lambda trace: None, lambda key, seg: None)
+
+
+def _anonymiser(tmp_path):
+    return Anonymiser(TileSink(str(tmp_path / "tiles")), privacy=1,
+                      quantisation=3600)
+
+
+def _seg(i=1, n=2, t0=1000.0):
+    return Segment(id=i, next_id=n, min=t0, max=t0 + 30.0, length=500,
+                   queue=0)
+
+
+class TestSnapshotRoundtrip:
+    def test_batches_and_slices_survive(self, tmp_path):
+        b, a = _batcher(), _anonymiser(tmp_path)
+        batch = Batch(Point(lat=14.6, lon=121.0, accuracy=10, time=100))
+        batch.update(Point(lat=14.61, lon=121.01, accuracy=12, time=160))
+        batch.last_update = 160000
+        b.store["veh-1"] = batch
+        a.process("1 2", _seg())
+        assert a.slices and a.slice_of
+
+        b2, a2 = _batcher(), _anonymiser(tmp_path)
+        restore_bytes(snapshot_bytes(b, a), b2, a2)
+        assert set(b2.store) == {"veh-1"}
+        got = b2.store["veh-1"]
+        assert got.last_update == 160000
+        assert got.max_separation == pytest.approx(batch.max_separation)
+        assert [p.to_bytes() for p in got.points] == \
+            [p.to_bytes() for p in batch.points]
+        assert {k: [s.to_bytes() for s in v] for k, v in a2.slices.items()} \
+            == {k: [s.to_bytes() for s in v] for k, v in a.slices.items()}
+        assert a2.slice_of == a.slice_of
+
+    def test_empty_state_roundtrips(self, tmp_path):
+        b, a = _batcher(), _anonymiser(tmp_path)
+        b2, a2 = _batcher(), _anonymiser(tmp_path)
+        restore_bytes(snapshot_bytes(b, a), b2, a2)
+        assert not b2.store and not a2.slices
+
+
+class TestStateStore:
+    def test_restore_missing_file_is_fresh_start(self, tmp_path):
+        store = StateStore(str(tmp_path / "state.bin"))
+        assert store.restore(_batcher(), _anonymiser(tmp_path)) is False
+
+    def test_save_then_restore(self, tmp_path):
+        path = str(tmp_path / "state.bin")
+        b, a = _batcher(), _anonymiser(tmp_path)
+        b.store["u"] = Batch(Point(lat=1.0, lon=2.0, accuracy=5, time=7))
+        StateStore(path).save(b, a)
+
+        b2, a2 = _batcher(), _anonymiser(tmp_path)
+        assert StateStore(path).restore(b2, a2) is True
+        assert "u" in b2.store
+
+    def test_corrupt_snapshot_discarded(self, tmp_path):
+        path = tmp_path / "state.bin"
+        path.write_bytes(b"RTS1garbage")
+        assert StateStore(str(path)).restore(
+            _batcher(), _anonymiser(tmp_path)) is False
+
+    def test_truncated_snapshot_discarded(self, tmp_path):
+        b, a = _batcher(), _anonymiser(tmp_path)
+        b.store["u"] = Batch(Point(lat=1.0, lon=2.0, accuracy=5, time=7))
+        a.process("1 2", _seg())
+        raw = snapshot_bytes(b, a)
+        path = tmp_path / "state.bin"
+        path.write_bytes(raw[:len(raw) // 2])
+        b2, a2 = _batcher(), _anonymiser(tmp_path)
+        assert StateStore(str(path)).restore(b2, a2) is False
+        # clean-discard semantics: nothing half-restored is left behind
+        assert not b2.store and not a2.slices and not a2.slice_of
+
+    def test_maybe_save_respects_interval(self, tmp_path):
+        now = [0.0]
+        store = StateStore(str(tmp_path / "s.bin"), interval_s=30.0,
+                           clock=lambda: now[0])
+        b, a = _batcher(), _anonymiser(tmp_path)
+        assert store.maybe_save(b, a) is False
+        now[0] = 31.0
+        assert store.maybe_save(b, a) is True
+        assert store.maybe_save(b, a) is False
+
+
+class TestWorkerCrashResume:
+    def test_open_batches_survive_a_restart(self, tmp_path):
+        """Feed half a trace, 'crash' (no drain), restart from the
+        snapshot, feed the rest — reports must still fire, which can only
+        happen if the open batch crossed the restart."""
+        from reporter_tpu.service.server import ReporterService
+        from reporter_tpu.matcher import SegmentMatcher
+        from reporter_tpu.streaming.formatter import Formatter
+        from reporter_tpu.streaming.worker import StreamWorker, \
+            inproc_submitter
+        from reporter_tpu.synth import build_grid_city, generate_trace
+
+        city = build_grid_city(rows=10, cols=10, spacing_m=200.0, seed=5,
+                               service_road_fraction=0.0,
+                               internal_fraction=0.0)
+        service = ReporterService(SegmentMatcher(net=city),
+                                  threshold_sec=15, max_wait_ms=1.0)
+        rng = np.random.default_rng(3)
+        tr = None
+        while tr is None:
+            tr = generate_trace(city, "veh", rng, noise_m=3.0,
+                                min_route_edges=10)
+        lines = [f"veh|{p['lat']}|{p['lon']}|{p['time']}|{p['accuracy']}"
+                 for p in tr.points]
+        fmt = ",sv,\\|,0,1,2,3,4"
+        out = str(tmp_path / "results")
+        state_path = str(tmp_path / "state.bin")
+
+        def make_worker():
+            return StreamWorker(
+                Formatter.from_config(fmt), inproc_submitter(service),
+                Anonymiser(TileSink(out), privacy=1, quantisation=3600,
+                           source="t"),
+                flush_interval_s=1e9,
+                state=StateStore(state_path, interval_s=0.0))
+
+        w1 = make_worker()
+        assert w1.restored is False
+        half = len(lines) // 4  # not enough points to have reported yet
+        for line in lines[:half]:
+            w1.offer(line)
+        # snapshot happened via maybe_save (interval 0); simulate crash: no
+        # drain, worker dropped
+        assert w1.processed == half
+
+        w2 = make_worker()
+        assert w2.restored is True
+        assert "veh" in w2.batcher.store
+        assert len(w2.batcher.store["veh"].points) == half
+        for line in lines[half:]:
+            w2.offer(line)
+        w2.drain()
+
+        import os
+        tile_files = [os.path.join(r, f)
+                      for r, _d, fs in os.walk(out) for f in fs]
+        assert tile_files, "no tiles written after resume"
