@@ -80,15 +80,43 @@ def assemble_segments(net: RoadNetwork, prepared, path: np.ndarray,
     ``prepared`` is a PreparedTrace (host tensors incl. times);
     ``path`` is the device-decoded (T,) candidate index per point.
     """
-    times = prepared.times
-    case = prepared.case
+    n = int(prepared.num_kept)
+    if n == 0:
+        return {"segments": [], "mode": mode}
+
+    # one vectorised gather pass, then plain-scalar control flow: per-element
+    # numpy indexing/int()/float() dominates this walk otherwise
+    ks = np.asarray(path[:n], dtype=np.int64)
+    rows = np.arange(n)
+    edges = prepared.edge_ids[rows, ks].astype(np.int64)
+    pad = edges == PAD_EDGE
+    safe = np.where(pad, 0, edges)
+    seg_ids = net.edge_segment_id[safe]
+    seg_pos = net.edge_segment_offset_m[safe].astype(np.float64) + \
+        prepared.offset_m[rows, ks]
+    internal = net.edge_internal[safe]
+    kept = np.asarray(prepared.kept_idx[:n], dtype=np.int64)
+    times_kept = np.asarray(prepared.times)[kept]
+    restarts = prepared.case[:n] == RESTART
+    steps = prepared.route_m[np.arange(n - 1), ks[:-1], ks[1:]] if n > 1 \
+        else np.zeros(0, dtype=np.float32)
+
+    edges_l = edges.tolist()
+    pad_l = pad.tolist()
+    seg_ids_l = seg_ids.tolist()
+    seg_pos_l = seg_pos.tolist()
+    internal_l = internal.tolist()
+    kept_l = kept.tolist()
+    times_l = times_kept.tolist()
+    restart_l = restarts.tolist()
+    steps_l = steps.tolist()
 
     segments: List[dict] = []
 
     # walk chains of kept points, split at RESTART boundaries; excluded
     # points (jitter/no-candidate) fall inside the surrounding runs' index
     # spans and need no explicit handling here
-    chain: List[tuple] = []  # (orig_idx, edge, seg_id, seg_pos, time, cum)
+    chain: List[tuple] = []  # (orig_idx, edge, seg_id, seg_pos, time, cum, internal)
 
     def flush_chain():
         if chain:
@@ -96,32 +124,27 @@ def assemble_segments(net: RoadNetwork, prepared, path: np.ndarray,
         chain.clear()
 
     cum = 0.0
-    prev_t = None
-    for t in range(prepared.num_kept):
-        orig = int(prepared.kept_idx[t])
-        if case[t] == RESTART:
+    prev_ok = False
+    for t in range(n):
+        if restart_l[t]:
             flush_chain()
             cum = 0.0
-            prev_t = None
-        k = int(path[t])
-        edge = int(prepared.edge_ids[t, k])
-        if edge == PAD_EDGE:
+            prev_ok = False
+        if pad_l[t]:
             flush_chain()
-            prev_t = None
+            prev_ok = False
             continue
-        if prev_t is not None:
-            step = float(prepared.route_m[t - 1, int(path[t - 1]), k])
+        if prev_ok:
+            step = steps_l[t - 1]
             if step >= UNREACHABLE / 2:
                 # decoder was forced through an unroutable pair; break here
                 flush_chain()
                 cum = 0.0
             else:
                 cum += step
-        seg_id = int(net.edge_segment_id[edge])
-        seg_pos = float(net.edge_segment_offset_m[edge]) + \
-            float(prepared.offset_m[t, k])
-        chain.append((orig, edge, seg_id, seg_pos, float(times[orig]), cum))
-        prev_t = t
+        chain.append((kept_l[t], edges_l[t], seg_ids_l[t], seg_pos_l[t],
+                      times_l[t], cum, internal_l[t]))
+        prev_ok = True
     flush_chain()
 
     return {"segments": segments, "mode": mode}
@@ -130,8 +153,7 @@ def assemble_segments(net: RoadNetwork, prepared, path: np.ndarray,
 def _chain_to_segments(net: RoadNetwork, chain: List[tuple]) -> List[dict]:
     # group the chain into runs of one segment (or one unassociated stretch)
     runs: List[_Run] = []
-    for idx, edge, seg_id, seg_pos, time, cum in chain:
-        internal = bool(net.edge_internal[edge])
+    for idx, edge, seg_id, seg_pos, time, cum, internal in chain:
         sid = seg_id if seg_id >= 0 else None
         same = (
             runs

@@ -25,7 +25,8 @@ from ..core.geo import equirectangular_m
 from ..graph.network import RoadNetwork
 from ..graph.route import RouteCache, candidate_route_matrices, UNREACHABLE
 from ..graph.spatial import CandidateSet, SpatialGrid, PAD_EDGE, PAD_DIST
-from .hmm import NORMAL, RESTART, SKIP
+from .hmm import (
+    NORMAL, RESTART, SKIP, UNREACHABLE_THRESHOLD, WIRE_MAX_M)
 from .params import MatchParams
 
 LENGTH_BUCKETS = (16, 64, 256, 1024)
@@ -162,35 +163,90 @@ class PaddedBatch:
     case: np.ndarray     # (B, T) i32
 
 
+def _wire_f16() -> bool:
+    import logging
+    import os
+    val = os.environ.get("REPORTER_TPU_WIRE", "f16").strip().lower()
+    if val not in ("f16", "f32"):
+        logging.getLogger("reporter_tpu.matcher").warning(
+            "REPORTER_TPU_WIRE=%r not recognised (use f16|f32); keeping f16",
+            val)
+        return True
+    return val != "f32"
+
+
+def _f16_safe(p: PreparedTrace) -> bool:
+    """True when every finite distance in the trace fits the f16 wire
+    undistorted (sentinel values >= UNREACHABLE_THRESHOLD travel as +inf)."""
+    if p.gc_m.size and float(np.amax(p.gc_m)) > WIRE_MAX_M:
+        return False
+    for arr in (p.route_m, p.dist_m):
+        if arr.size and float(np.amax(
+                arr, initial=0.0,
+                where=arr < UNREACHABLE_THRESHOLD)) > WIRE_MAX_M:
+            return False
+    return True
+
+
 def pack_batches(prepared: Sequence[PreparedTrace],
-                 pad_batch_to: int | None = None) -> List[PaddedBatch]:
+                 pad_batch_to: int | None = None,
+                 max_batch: int | None = None) -> List[PaddedBatch]:
     """Group prepared traces by bucket length and stack into batches.
 
     ``pad_batch_to`` optionally rounds the batch dimension up to a multiple
     (useful to keep the compiled-shape count low in a long-running service);
-    filler rows are all-SKIP traces that decode to nothing.
+    filler rows are all-SKIP traces that decode to nothing. ``max_batch``
+    splits a group into chunks of at most that many traces so host->device
+    transfer, decode, and host post-processing of successive chunks can
+    overlap (the dispatch pipeline in SegmentMatcher.match_many).
+
+    By default the float tensors are built in the f16 wire format — the
+    cast happens inside the copy the pack already performs, halving
+    host->device bytes; the unreachable/pad sentinels overflow to +inf,
+    which the device scoring treats identically (matcher/hmm.py). A batch
+    containing any trace with finite distances beyond f16 range (extreme
+    breakage_distance overrides) falls back to f32, as does setting
+    REPORTER_TPU_WIRE=f32.
     """
     by_T: dict[int, List[PreparedTrace]] = {}
     for p in prepared:
         by_T.setdefault(p.T, []).append(p)
 
-    batches = []
+    # pad and dtype decisions are per T-bucket (one compiled (shape, dtype)
+    # per bucket): only buckets actually split by max_batch pad their tail
+    # up to the chunk size; small buckets keep their exact B (or the
+    # caller's rounding); one out-of-range trace anywhere in a bucket puts
+    # the whole bucket on the f32 wire rather than mixing dtypes mid-request
+    f16 = _wire_f16()
+    chunked: List[tuple] = []  # (T, group, pad, dtype)
     for T, group in sorted(by_T.items()):
+        dtype = np.float16 if f16 and all(map(_f16_safe, group)) \
+            else np.float32
+        if max_batch and len(group) > max_batch:
+            chunked.extend((T, group[i:i + max_batch], max_batch, dtype)
+                           for i in range(0, len(group), max_batch))
+        else:
+            chunked.append((T, group, pad_batch_to, dtype))
+
+    batches = []
+    for T, group, pad, dtype in chunked:
         B = len(group)
-        if pad_batch_to:
-            B = ((B + pad_batch_to - 1) // pad_batch_to) * pad_batch_to
+        if pad:
+            B = ((B + pad - 1) // pad) * pad
         K = group[0].edge_ids.shape[1]
-        dist = np.full((B, T, K), PAD_DIST, dtype=np.float32)
-        valid = np.zeros((B, T, K), dtype=bool)
-        route = np.full((B, max(T - 1, 0), K, K), UNREACHABLE, dtype=np.float32)
-        gc = np.zeros((B, max(T - 1, 0)), dtype=np.float32)
-        case = np.full((B, T), SKIP, dtype=np.int32)
-        for b, p in enumerate(group):
-            dist[b] = p.dist_m
-            valid[b] = p.edge_ids != PAD_EDGE
-            route[b] = p.route_m
-            gc[b] = p.gc_m
-            case[b] = p.case
+        with np.errstate(over="ignore"):  # sentinels overflow f16 to +inf
+            dist = np.full((B, T, K), PAD_DIST, dtype=dtype)
+            valid = np.zeros((B, T, K), dtype=bool)
+            route = np.full((B, max(T - 1, 0), K, K), UNREACHABLE,
+                            dtype=dtype)
+            gc = np.zeros((B, max(T - 1, 0)), dtype=dtype)
+            case = np.full((B, T), SKIP, dtype=np.int32)
+            for b, p in enumerate(group):
+                dist[b] = p.dist_m
+                valid[b] = p.edge_ids != PAD_EDGE
+                route[b] = p.route_m
+                gc[b] = p.gc_m
+                case[b] = p.case
         batches.append(PaddedBatch(traces=group, dist_m=dist, valid=valid,
                                    route_m=route, gc_m=gc, case=case))
     return batches

@@ -38,6 +38,13 @@ NEG_INF = jnp.float32(-1.0e30)
 NORMAL, RESTART, SKIP = 0, 1, 2
 # route distances at/above this threshold are "no route found within bound"
 UNREACHABLE_THRESHOLD = 0.5e9
+# largest finite distance the f16 wire format ships (sentinels above
+# UNREACHABLE_THRESHOLD travel as +inf). Bounded at 4096 m so the f16 ulp
+# stays <= 2 m (<= 1 m rounding) — noise well under the metre-scale
+# deviations the transition scores discriminate on; consecutive-probe
+# route/great-circle distances are typically tens of metres. Batches with
+# finite distances beyond this ship f32 instead (pack_batches fallback).
+WIRE_MAX_M = 4.096e3
 
 
 def emission_scores(dist_m: jnp.ndarray, valid: jnp.ndarray,
@@ -48,6 +55,9 @@ def emission_scores(dist_m: jnp.ndarray, valid: jnp.ndarray,
     per-point case codes, ``sigma`` scalar effective sigma_z.
     SKIP rows become all-zero so they never poison the running scores.
     """
+    # scoring always runs in f32: callers may ship the wire tensors as f16
+    # to halve host->device transfer (ops.decode_batch)
+    dist_m = dist_m.astype(jnp.float32)
     z = dist_m / sigma
     scores = jnp.where(valid, -0.5 * z * z, NEG_INF)
     return jnp.where((case == SKIP)[:, None], 0.0, scores)
@@ -63,6 +73,10 @@ def transition_scores(route_m: jnp.ndarray, gc_m: jnp.ndarray,
     distances become -inf.
     """
     K = route_m.shape[-1]
+    # f16 wire tensors (ops.decode_batch) carry unreachable as +inf, which
+    # upcasts cleanly and still fails the reachability test below
+    route_m = route_m.astype(jnp.float32)
+    gc_m = gc_m.astype(jnp.float32)
     dev = jnp.abs(route_m - gc_m[:, None, None])
     scores = jnp.where(route_m < UNREACHABLE_THRESHOLD, -dev / beta, NEG_INF)
     identity = jnp.where(jnp.eye(K, dtype=bool), 0.0, NEG_INF)

@@ -15,6 +15,7 @@ Viterbi per padding bucket.
 from __future__ import annotations
 
 import json
+import os
 from typing import List, Optional, Sequence
 
 import numpy as np
@@ -29,6 +30,14 @@ from .params import MatchParams
 # process-wide configuration, mirroring valhalla.Configure's module-level
 # behavior (reference: reporter_service.py:284)
 _global_config: dict = {}
+
+
+def _decode_chunk() -> int:
+    """Chunk size for the decode dispatch pipeline (env-tunable)."""
+    try:
+        return max(1, int(os.environ.get("REPORTER_TPU_DECODE_CHUNK", 128)))
+    except ValueError:
+        return 128
 
 
 def Configure(conf) -> None:
@@ -138,14 +147,24 @@ class SegmentMatcher:
         for p, params in zip(prepared, per_trace_params):
             key = (params.effective_sigma, params.beta)
             groups.setdefault(key, []).append(p)
+        # two-phase dispatch: enqueue every chunk's decode + its async
+        # device->host copy before draining any, so transfer and compute of
+        # later chunks overlap host-side work on earlier ones (the h2d copy
+        # is the bottleneck on tunneled chips, not the decode itself)
+        chunk = _decode_chunk()
+        pending = []
         for (sigma, beta), group in groups.items():
-            for batch in pack_batches(group):
+            for batch in pack_batches(group, max_batch=chunk):
                 decoded, _scores = decode_batch(
                     batch.dist_m, batch.valid, batch.route_m, batch.gc_m,
                     batch.case, np.float32(sigma), np.float32(beta))
-                decoded = np.asarray(decoded)
-                for b, ptrace in enumerate(batch.traces):
-                    paths[index_of[id(ptrace)]] = decoded[b]
+                if hasattr(decoded, "copy_to_host_async"):
+                    decoded.copy_to_host_async()
+                pending.append((batch, decoded))
+        for batch, decoded in pending:
+            decoded = np.asarray(decoded)
+            for b, ptrace in enumerate(batch.traces):
+                paths[index_of[id(ptrace)]] = decoded[b]
 
         results = []
         for i, (tr, ptrace) in enumerate(zip(traces, prepared)):

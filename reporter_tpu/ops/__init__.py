@@ -41,7 +41,11 @@ def decode_backend(T: int, K: int) -> str:
 
 def decode_batch(dist_m, valid, route_m, gc_m, case, sigma, beta):
     """Backend-dispatched batched Viterbi decode; same contract as
-    matcher.hmm.viterbi_decode_batch."""
+    matcher.hmm.viterbi_decode_batch.
+
+    Accepts f32 tensors or the f16 wire format (built by
+    matcher.batchpad.pack_batches, the single owner of the wire policy) —
+    the scoring kernels upcast on device either way."""
     backend = decode_backend(T=dist_m.shape[1], K=dist_m.shape[2])
     if backend == "pallas":
         interpret = jax.default_backend() != "tpu"

@@ -200,3 +200,91 @@ class TestBatching:
         matches = matcher.match_many(reqs)
         assert len(matches) == 4
         assert all(m["segments"] for m in matches)
+
+
+class TestWireEncoding:
+    """pack_batches owns the f16 wire policy; decode must be unchanged."""
+
+    def _toy_arrays(self):
+        rng = np.random.default_rng(11)
+        B, T, K = 4, 12, 5
+        dist = rng.uniform(0.0, 40.0, (B, T, K)).astype(np.float32)
+        valid = np.ones((B, T, K), dtype=bool)
+        gc = rng.uniform(5.0, 40.0, (B, T - 1)).astype(np.float32)
+        route = (gc[..., None, None]
+                 + rng.exponential(15.0, (B, T - 1, K, K))).astype(np.float32)
+        case = np.full((B, T), NORMAL, dtype=np.int32)
+        case[:, 0] = RESTART
+        return dist, valid, route, gc, case
+
+    def test_f16_wire_matches_f32(self):
+        """Kernels upcast f16 inputs: decoded paths must match f32 inputs."""
+        from reporter_tpu.graph.route import UNREACHABLE
+        from reporter_tpu.ops import decode_batch
+
+        dist, valid, route, gc, case = self._toy_arrays()
+        # make some pairs unreachable so the +inf sentinel crosses the wire
+        route[:, 3, 1:, :] = UNREACHABLE
+        sigma, beta = np.float32(4.07), np.float32(3.0)
+        p32, s32 = decode_batch(dist, valid, route, gc, case, sigma, beta)
+        with np.errstate(over="ignore"):
+            d16, r16, g16 = (dist.astype(np.float16),
+                             route.astype(np.float16),
+                             gc.astype(np.float16))
+        assert np.isinf(r16[0, 3, 1, 0])
+        p16, s16 = decode_batch(d16, valid, r16, g16, case, sigma, beta)
+        np.testing.assert_array_equal(np.asarray(p32), np.asarray(p16))
+        np.testing.assert_allclose(np.asarray(s32), np.asarray(s16),
+                                   rtol=2e-2, atol=0.5)
+
+    def test_pack_batches_emits_f16_wire(self, city, matcher):
+        from reporter_tpu.graph.route import UNREACHABLE
+
+        traces = [make_trace(city, s) for s in range(2)]
+        prepared = [prepare_trace(city, matcher.grid, t.points,
+                                  matcher.params, matcher.route_cache)
+                    for t in traces]
+        (b,) = pack_batches(prepared)
+        assert b.dist_m.dtype == np.float16
+        assert b.route_m.dtype == np.float16
+        assert b.gc_m.dtype == np.float16
+        # unreachable sentinels travel as +inf
+        unreachable = np.concatenate(
+            [p.route_m.ravel() >= UNREACHABLE / 2 for p in prepared])
+        assert np.isinf(b.route_m.reshape(len(prepared), -1)
+                        .ravel()[unreachable]).all()
+        # finite values survive within f16 rounding
+        for i, p in enumerate(prepared):
+            finite = p.route_m < UNREACHABLE / 2
+            np.testing.assert_allclose(
+                b.route_m[i].astype(np.float32)[finite],
+                p.route_m[finite], rtol=1e-3)
+
+    def test_pack_batches_f32_env_override(self, city, matcher, monkeypatch):
+        monkeypatch.setenv("REPORTER_TPU_WIRE", "f32")
+        traces = [make_trace(city, 9)]
+        prepared = [prepare_trace(city, matcher.grid, traces[0].points,
+                                  matcher.params, matcher.route_cache)]
+        (b,) = pack_batches(prepared)
+        assert b.route_m.dtype == np.float32
+
+    def test_pack_batches_f32_fallback_out_of_range(self, city, matcher):
+        """A finite distance beyond WIRE_MAX_M forces the f32 wire."""
+        from reporter_tpu.matcher.hmm import WIRE_MAX_M
+
+        tr = make_trace(city, 4)
+        p = prepare_trace(city, matcher.grid, tr.points,
+                          matcher.params, matcher.route_cache)
+        p.route_m[0, 0, 0] = WIRE_MAX_M * 2  # finite, beyond f16-safe
+        (b,) = pack_batches([p])
+        assert b.route_m.dtype == np.float32
+        assert b.route_m[0, 0, 0, 0] == WIRE_MAX_M * 2
+
+    def test_small_bucket_not_padded_to_chunk(self, city, matcher):
+        # a bucket smaller than max_batch keeps its exact batch size
+        traces = [make_trace(city, s) for s in range(3)]
+        prepared = [prepare_trace(city, matcher.grid, t.points,
+                                  matcher.params, matcher.route_cache)
+                    for t in traces]
+        batches = pack_batches(prepared, max_batch=128)
+        assert all(b.dist_m.shape[0] == len(b.traces) for b in batches)
