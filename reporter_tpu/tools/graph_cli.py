@@ -6,6 +6,7 @@ read-only — Dockerfile:42-49): here the framework owns the format, so it
 also owns construction.
 
   build-synth   generate a synthetic grid city -> monolithic .npz
+  import-osm    parse raw OSM XML -> monolithic .npz (graph/osm.py)
   tile          partition a monolithic .npz into an RGT tile tree
   untile        compose a tile tree (optionally bbox-scoped) -> .npz
   info          counts for a .npz or tile tree
@@ -27,6 +28,11 @@ def main(argv=None):
     p_b.add_argument("--spacing-m", type=float, default=200.0)
     p_b.add_argument("--seed", type=int, default=0)
     p_b.add_argument("--out", required=True, help=".npz path")
+
+    p_o = sub.add_parser("import-osm", help="parse OSM XML into a graph")
+    p_o.add_argument("--in", dest="osm_in", required=True,
+                     help="OSM XML file (.osm / .xml)")
+    p_o.add_argument("--out", required=True, help=".npz path")
 
     p_t = sub.add_parser("tile", help="partition a .npz into RGT tiles")
     p_t.add_argument("--graph", required=True)
@@ -53,6 +59,13 @@ def main(argv=None):
         net.save(args.out)
         print(f"wrote {args.out}: {net.num_nodes} nodes, "
               f"{net.num_edges} edges")
+    elif args.cmd == "import-osm":
+        from ..graph.osm import network_from_osm_xml
+        net = network_from_osm_xml(args.osm_in)
+        net.save(args.out)
+        print(f"wrote {args.out}: {net.num_nodes} nodes, "
+              f"{net.num_edges} edges, "
+              f"{len(net.segment_length_m)} OSMLR segments")
     elif args.cmd == "tile":
         net = RoadNetwork.load(args.graph)
         written = write_tiles(net, args.out_dir)

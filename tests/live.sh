@@ -7,14 +7,14 @@
 # so segment ids actually resolve; otherwise the default synthetic city
 # matching `python -m reporter_tpu serve` on a build-synth config is used.
 set -euo pipefail
+GRAPH_ARGS=()
+# resolve a relative graph path against the caller's cwd before cd-ing
+if [ "$#" -ge 1 ]; then GRAPH_ARGS=(--graph "$(realpath "$1")"); fi
 cd "$(dirname "$0")/.."
 . tests/env.sh
 
 WORK=$(mktemp -d)
 trap 'rm -rf "${WORK}"' EXIT
-
-GRAPH_ARGS=()
-if [ "$#" -ge 1 ]; then GRAPH_ARGS=(--graph "$1"); fi
 
 echo "[live] synthesising canned request bodies"
 python -m reporter_tpu synth "${GRAPH_ARGS[@]}" --traces 8 --seed 11 \

@@ -1,0 +1,163 @@
+"""OSM XML importer (graph/osm.py): parsing, classification, OSMLR ids,
+and end-to-end matching on an imported network."""
+import io
+
+import numpy as np
+import pytest
+
+from reporter_tpu.core.osmlr import segment_index, tile_level
+from reporter_tpu.graph.osm import network_from_osm_xml
+
+# A small real-shaped extract: a primary two-way street, a oneway
+# residential, a reverse-oneway street, a motorway ramp (internal), a
+# service alley (unassociated), a non-drivable footway, and a way with a
+# node missing from the extract (clipped).
+OSM_XML = """<?xml version="1.0" encoding="UTF-8"?>
+<osm version="0.6" generator="test">
+  <node id="1" lat="14.5800" lon="121.0000"/>
+  <node id="2" lat="14.5810" lon="121.0000"/>
+  <node id="3" lat="14.5820" lon="121.0000"/>
+  <node id="4" lat="14.5810" lon="121.0010"/>
+  <node id="5" lat="14.5820" lon="121.0010"/>
+  <node id="6" lat="14.5800" lon="121.0010"/>
+  <node id="7" lat="14.5830" lon="121.0000"/>
+  <way id="100">
+    <nd ref="1"/><nd ref="2"/><nd ref="3"/>
+    <tag k="highway" v="primary"/>
+    <tag k="maxspeed" v="50"/>
+  </way>
+  <way id="101">
+    <nd ref="2"/><nd ref="4"/>
+    <tag k="highway" v="residential"/>
+    <tag k="oneway" v="yes"/>
+  </way>
+  <way id="102">
+    <nd ref="4"/><nd ref="5"/>
+    <tag k="highway" v="residential"/>
+    <tag k="oneway" v="-1"/>
+    <tag k="maxspeed" v="20 mph"/>
+  </way>
+  <way id="103">
+    <nd ref="3"/><nd ref="5"/>
+    <tag k="highway" v="motorway_link"/>
+  </way>
+  <way id="104">
+    <nd ref="4"/><nd ref="6"/>
+    <tag k="highway" v="service"/>
+  </way>
+  <way id="105">
+    <nd ref="1"/><nd ref="6"/>
+    <tag k="highway" v="footway"/>
+  </way>
+  <way id="106">
+    <nd ref="7"/><nd ref="999"/>
+    <tag k="highway" v="residential"/>
+  </way>
+</osm>
+"""
+
+
+@pytest.fixture(scope="module")
+def net():
+    return network_from_osm_xml(io.BytesIO(OSM_XML.encode()))
+
+
+def _edges_between(net, a_osm_idx, b_osm_idx):
+    return [e for e in range(net.num_edges)
+            if net.edge_start[e] == a_osm_idx and net.edge_end[e] == b_osm_idx]
+
+
+class TestImport:
+    def test_counts(self, net):
+        # way 100: 2 node pairs x 2 dirs = 4; way 101: 1; way 102: 1;
+        # way 103: 2 dirs? no - _link is internal but still two-way: 2;
+        # way 104 service two-way: 2; footway skipped; clipped way dropped
+        assert net.num_edges == 4 + 1 + 1 + 2 + 2
+
+    def test_two_way_and_oneway(self, net):
+        s = net.edge_start.tolist()
+        e = net.edge_end.tolist()
+        pairs = set(zip(s, e))
+        # primary is bidirectional between consecutive nodes
+        assert (0, 1) in pairs and (1, 0) in pairs
+        # oneway=yes: only forward
+        assert (1, 3) in pairs and (3, 1) not in pairs
+        # oneway=-1: only reverse
+        assert (4, 3) in pairs and (3, 4) not in pairs
+
+    def test_speeds(self, net):
+        e_fwd = _edges_between(net, 0, 1)[0]
+        assert net.edge_speed_kph[e_fwd] == pytest.approx(50.0)
+        e_rev = _edges_between(net, 4, 3)[0]
+        assert net.edge_speed_kph[e_rev] == pytest.approx(32.19, abs=0.01)
+
+    def test_osmlr_levels_and_association(self, net):
+        e_primary = _edges_between(net, 0, 1)[0]
+        sid = int(net.edge_segment_id[e_primary])
+        assert sid >= 0
+        assert tile_level(sid) == 1  # primary -> arterial level
+        assert sid in net.segment_length_m
+        e_res = _edges_between(net, 1, 3)[0]
+        assert tile_level(int(net.edge_segment_id[e_res])) == 2
+
+    def test_internal_and_service_unassociated(self, net):
+        e_ramp = _edges_between(net, 2, 4)[0]
+        assert net.edge_internal[e_ramp]
+        assert net.edge_segment_id[e_ramp] == -1
+        e_svc = _edges_between(net, 3, 5)[0]
+        assert not net.edge_internal[e_svc]
+        assert net.edge_segment_id[e_svc] == -1
+
+    def test_direction_segments_distinct(self, net):
+        # each direction of a two-way associated way is its own segment
+        e_fwd = _edges_between(net, 0, 1)[0]
+        e_rev = _edges_between(net, 1, 0)[0]
+        a, b = int(net.edge_segment_id[e_fwd]), int(net.edge_segment_id[e_rev])
+        assert a != b and a >= 0 and b >= 0
+        assert segment_index(a) != segment_index(b)
+
+    def test_segment_offsets_cumulative(self, net):
+        # second edge of the primary chain starts where the first ends
+        e1 = _edges_between(net, 0, 1)[0]
+        e2 = _edges_between(net, 1, 2)[0]
+        assert int(net.edge_segment_id[e1]) == int(net.edge_segment_id[e2])
+        assert net.edge_segment_offset_m[e1] == pytest.approx(0.0)
+        assert net.edge_segment_offset_m[e2] == pytest.approx(
+            net.edge_length_m[e1], rel=1e-5)
+        sid = int(net.edge_segment_id[e1])
+        assert net.segment_length_m[sid] == pytest.approx(
+            float(net.edge_length_m[e1] + net.edge_length_m[e2]), rel=1e-5)
+
+    def test_no_drivable_ways_raises(self):
+        xml = ('<?xml version="1.0"?><osm>'
+               '<node id="1" lat="0" lon="0"/></osm>')
+        with pytest.raises(ValueError):
+            network_from_osm_xml(io.BytesIO(xml.encode()))
+
+    def test_roundtrip_npz(self, net, tmp_path):
+        from reporter_tpu.graph.network import RoadNetwork
+        p = tmp_path / "osm.npz"
+        net.save(str(p))
+        back = RoadNetwork.load(str(p))
+        assert back.num_edges == net.num_edges
+        np.testing.assert_array_equal(back.edge_segment_id,
+                                      net.edge_segment_id)
+
+
+class TestMatchOnImported:
+    def test_trace_matches_primary_street(self, net):
+        """Probes along the primary way decode to its OSMLR segment."""
+        from reporter_tpu.matcher import MatchParams, SegmentMatcher
+
+        m = SegmentMatcher(net=net, params=MatchParams(max_candidates=4))
+        rng = np.random.default_rng(0)
+        # walk node 1 -> 3 (indices 0..2) at ~30 km/h with 3 m noise
+        lats = np.linspace(14.5800, 14.5820, 12)
+        pts = [{"lat": float(la + rng.normal(0, 3e-5)),
+                "lon": float(121.0 + rng.normal(0, 3e-5)),
+                "time": 1500000000 + i * 7} for i, la in enumerate(lats)]
+        out = m.match_many([{"uuid": "osm-veh", "trace": pts}])[0]
+        sids = {s.get("segment_id") for s in out["segments"]
+                if "segment_id" in s}
+        e_fwd = _edges_between(net, 0, 1)[0]
+        assert int(net.edge_segment_id[e_fwd]) in sids
