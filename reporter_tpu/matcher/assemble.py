@@ -33,6 +33,12 @@ from .hmm import RESTART
 # as having been observed at the boundary itself
 _BOUNDARY_EPS = 1.0
 
+# queue_length extrapolates from the queue's observed back edge to the
+# segment end (reference README.md:283 anchors the field at the end); a
+# stall observed further than this from the end says nothing about the end
+# of the segment, so no queue is reported
+_QUEUE_END_PROXIMITY_M = 100.0
+
 
 def _interp_time(pos: float, pos_a: float, pos_b: float,
                  time_a: float, time_b: float) -> float:
@@ -50,7 +56,7 @@ class _Run:
     __slots__ = ("segment_id", "internal", "first_idx", "last_idx",
                  "first_pos", "last_pos", "first_time", "last_time",
                  "first_cum", "last_cum", "edges",
-                 "start_time", "end_time")
+                 "start_time", "end_time", "queue_start")
 
     def __init__(self, segment_id: Optional[int], internal: bool, idx: int,
                  pos: float, time: float, cum: float, edge: int):
@@ -63,8 +69,21 @@ class _Run:
         self.edges = [edge]
         self.start_time: float = -1.0
         self.end_time: float = -1.0
+        # segment position where the current trailing slow stretch began;
+        # None while traffic is moving (reference: README.md:283 —
+        # queue_length is the slow tail measured from the segment end)
+        self.queue_start: Optional[float] = None
 
-    def extend(self, idx: int, pos: float, time: float, cum: float, edge: int):
+    def extend(self, idx: int, pos: float, time: float, cum: float, edge: int,
+               queue_threshold_kph: float):
+        dt = time - self.last_time
+        if dt > 0.0:
+            speed_kph = (pos - self.last_pos) / dt * 3.6
+            if speed_kph < queue_threshold_kph:
+                if self.queue_start is None:
+                    self.queue_start = self.last_pos
+            else:
+                self.queue_start = None
         self.last_idx = idx
         self.last_pos = pos
         self.last_time = time
@@ -72,9 +91,21 @@ class _Run:
         if self.edges[-1] != edge:
             self.edges.append(edge)
 
+    def queue_length(self, seg_len: float) -> int:
+        if self.segment_id is None or self.queue_start is None \
+                or seg_len <= 0.0:
+            return 0
+        # only extrapolate to the segment end when the queue was actually
+        # observed near it (last observation within the proximity bound)
+        if seg_len - self.last_pos > _QUEUE_END_PROXIMITY_M:
+            return 0
+        return int(round(max(seg_len - self.queue_start, 0.0)))
+
 
 def assemble_segments(net: RoadNetwork, prepared, path: np.ndarray,
-                      mode: str = "auto") -> dict:
+                      mode: str = "auto",
+                      queue_threshold_kph: float = 10.0,
+                      interpolation_distance_m: float = 10.0) -> dict:
     """Build the match dict for one trace.
 
     ``prepared`` is a PreparedTrace (host tensors incl. times);
@@ -113,14 +144,28 @@ def assemble_segments(net: RoadNetwork, prepared, path: np.ndarray,
 
     segments: List[dict] = []
 
+    # a vehicle stalled at trace end emits points the jitter filter drops
+    # (all within interpolation_distance of the last kept point), so the
+    # kept-point speeds never see the stall; the dwell time of that raw
+    # tail bounds its speed and marks the queue instead. batchpad computes
+    # the dwell only for verifiably-jitter tails (0 for off-network or
+    # bucket-truncated tails, which carry no stay-put guarantee). Mid-trace
+    # stalls need no special case: dropped points stretch dt between kept
+    # points.
+    trailing_dwell_s = float(getattr(prepared, "trailing_jitter_dwell_s",
+                                     0.0))
+
     # walk chains of kept points, split at RESTART boundaries; excluded
     # points (jitter/no-candidate) fall inside the surrounding runs' index
     # spans and need no explicit handling here
     chain: List[tuple] = []  # (orig_idx, edge, seg_id, seg_pos, time, cum, internal)
 
-    def flush_chain():
+    def flush_chain(final: bool = False):
         if chain:
-            segments.extend(_chain_to_segments(net, chain))
+            segments.extend(_chain_to_segments(
+                net, chain, queue_threshold_kph,
+                trailing_dwell_s=trailing_dwell_s if final else 0.0,
+                interpolation_distance_m=interpolation_distance_m))
         chain.clear()
 
     cum = 0.0
@@ -145,12 +190,15 @@ def assemble_segments(net: RoadNetwork, prepared, path: np.ndarray,
         chain.append((kept_l[t], edges_l[t], seg_ids_l[t], seg_pos_l[t],
                       times_l[t], cum, internal_l[t]))
         prev_ok = True
-    flush_chain()
+    flush_chain(final=True)
 
     return {"segments": segments, "mode": mode}
 
 
-def _chain_to_segments(net: RoadNetwork, chain: List[tuple]) -> List[dict]:
+def _chain_to_segments(net: RoadNetwork, chain: List[tuple],
+                       queue_threshold_kph: float = 10.0,
+                       trailing_dwell_s: float = 0.0,
+                       interpolation_distance_m: float = 10.0) -> List[dict]:
     # group the chain into runs of one segment (or one unassociated stretch)
     runs: List[_Run] = []
     for idx, edge, seg_id, seg_pos, time, cum, internal in chain:
@@ -163,9 +211,20 @@ def _chain_to_segments(net: RoadNetwork, chain: List[tuple]) -> List[dict]:
             and not (sid is not None and seg_pos < runs[-1].last_pos - _BOUNDARY_EPS)
         )
         if same:
-            runs[-1].extend(idx, seg_pos, time, cum, edge)
+            runs[-1].extend(idx, seg_pos, time, cum, edge,
+                            queue_threshold_kph)
         else:
             runs.append(_Run(sid, internal, idx, seg_pos, time, cum, edge))
+
+    # trailing raw-point dwell (see assemble_segments): the dropped tail
+    # stayed within interpolation_distance for dwell seconds — if even the
+    # upper-bound speed is below the queue threshold, the vehicle is queued
+    # at its last decoded position
+    if trailing_dwell_s > 0.0 and runs:
+        last_run = runs[-1]
+        bound_kph = interpolation_distance_m / trailing_dwell_s * 3.6
+        if bound_kph < queue_threshold_kph and last_run.queue_start is None:
+            last_run.queue_start = last_run.last_pos
 
     # interpolate boundary times between adjacent runs
     for a, b in zip(runs[:-1], runs[1:]):
@@ -213,7 +272,7 @@ def _chain_to_segments(net: RoadNetwork, chain: List[tuple]) -> List[dict]:
             "start_time": round(r.start_time, 3),
             "end_time": round(r.end_time, 3),
             "length": int(round(seg_len)) if complete else -1,
-            "queue_length": 0,
+            "queue_length": r.queue_length(max(seg_len, 0.0)),
             "internal": r.internal,
             "begin_shape_index": int(r.first_idx),
             "end_shape_index": int(r.last_idx),

@@ -55,6 +55,9 @@ class PreparedTrace:
     route_m: np.ndarray    # (T-1, K, K) f32
     gc_m: np.ndarray       # (T-1,) f32
     case: np.ndarray       # (T,) i32
+    # seconds the raw tail verifiably dwelt at the last kept point (jitter
+    # drops only; 0 when the tail was off-network or bucket-truncated)
+    trailing_jitter_dwell_s: float = 0.0
 
     @property
     def T(self) -> int:
@@ -98,9 +101,26 @@ def prepare_trace(net: RoadNetwork, grid: SpatialGrid | None,
     kept = _select_kept(lat, lon, has_cands, params.interpolation_distance)
     n = len(kept)
     T = bucket_length(max(n, 1))
-    if n > T:  # cap at the largest bucket
+    truncated = n > T
+    if truncated:  # cap at the largest bucket
         kept = kept[:T]
         n = T
+
+    # dwell time of a *jitter-only* trailing tail: every raw point after the
+    # last kept one must have candidates and sit within the interpolation
+    # distance of that kept point — i.e. the vehicle verifiably stayed put.
+    # Tails dropped for lacking candidates (off-network driving) or by
+    # bucket truncation carry no such guarantee and count no dwell. Used by
+    # segment assembly to detect a vehicle queued at trace end.
+    trailing_jitter_dwell_s = 0.0
+    if n and not truncated and int(kept[-1]) < num_raw - 1:
+        lk = int(kept[-1])
+        tail = np.arange(lk + 1, num_raw)
+        tail_gc = equirectangular_m(lat[lk], lon[lk], lat[tail], lon[tail])
+        if bool(has_cands[tail].all()) and \
+                bool((np.atleast_1d(tail_gc)
+                      < params.interpolation_distance).all()):
+            trailing_jitter_dwell_s = float(times[num_raw - 1] - times[lk])
 
     cands = CandidateSet(
         edge_ids=all_cands.edge_ids[kept], dist_m=all_cands.dist_m[kept],
@@ -149,7 +169,8 @@ def prepare_trace(net: RoadNetwork, grid: SpatialGrid | None,
     return PreparedTrace(num_raw=num_raw, num_kept=n, kept_idx=kept,
                          times=times, edge_ids=edge_ids, dist_m=dist,
                          offset_m=offset, route_m=route_p, gc_m=gc_p,
-                         case=case)
+                         case=case,
+                         trailing_jitter_dwell_s=trailing_jitter_dwell_s)
 
 
 @dataclass

@@ -168,7 +168,9 @@ class SegmentMatcher:
 
         results = []
         for i, (tr, ptrace) in enumerate(zip(traces, prepared)):
-            mode = per_trace_params[i].mode
-            results.append(
-                assemble_segments(self.net, ptrace, paths[i], mode=mode))
+            params = per_trace_params[i]
+            results.append(assemble_segments(
+                self.net, ptrace, paths[i], mode=params.mode,
+                queue_threshold_kph=params.queue_speed_threshold_kph,
+                interpolation_distance_m=params.interpolation_distance))
         return results

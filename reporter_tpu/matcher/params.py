@@ -30,6 +30,11 @@ class MatchParams:
     # block; suppresses one-point flickers onto the co-located reverse edge
     # (see graph/route.py route_distance)
     backward_tolerance_m: float = 25.0
+    # observed speeds below this mark queued traffic: queue_length is the
+    # distance from the segment end occupied by the slow tail (reference:
+    # README.md:283 defines the field; the C++ matcher's threshold constant
+    # is not published, so it is a knob here)
+    queue_speed_threshold_kph: float = 10.0
 
     def with_options(self, options: dict) -> "MatchParams":
         """Apply per-request ``match_options`` overrides by reference name

@@ -161,3 +161,73 @@ class TestMatchOnImported:
                 if "segment_id" in s}
         e_fwd = _edges_between(net, 0, 1)[0]
         assert int(net.edge_segment_id[e_fwd]) in sids
+
+
+class TestQueueLength:
+    """queue_length = slow tail measured from the segment end
+    (reference README.md:283)."""
+
+    def _match(self, net, pts):
+        from reporter_tpu.matcher import MatchParams, SegmentMatcher
+        m = SegmentMatcher(net=net, params=MatchParams(max_candidates=4))
+        return m.match_many([{"uuid": "q", "trace": pts}])[0]
+
+    def test_stalled_tail_reports_queue(self, net):
+        # fast along the primary, then creep near the segment end
+        pts, t = [], 1500000000
+        for la in np.linspace(14.5800, 14.58145, 8):
+            pts.append({"lat": float(la), "lon": 121.0, "time": t}); t += 3
+        for i in range(4):  # ~1.6 m / 7 s ≈ 0.8 km/h
+            pts.append({"lat": 14.58146 + i * 1.5e-5, "lon": 121.0,
+                        "time": t}); t += 7
+        out = self._match(net, pts)
+        seg = next(s for s in out["segments"] if "segment_id" in s)
+        assert seg["queue_length"] > 20
+        sid = seg["segment_id"]
+        assert seg["queue_length"] <= net.segment_length_m[sid]
+
+    def test_free_flow_has_no_queue(self, net):
+        pts = [{"lat": float(la), "lon": 121.0, "time": 1500000000 + i * 3}
+               for i, la in enumerate(np.linspace(14.5800, 14.5818, 10))]
+        out = self._match(net, pts)
+        for s in out["segments"]:
+            assert s["queue_length"] == 0
+
+    def test_midsegment_slowdown_then_recovery_clears_queue(self, net):
+        # slow in the middle, fast at the end: queue resets to 0
+        pts, t = [], 1500000000
+        for la in np.linspace(14.5800, 14.5808, 5):
+            pts.append({"lat": float(la), "lon": 121.0, "time": t}); t += 3
+        for i in range(3):  # crawl mid-segment
+            pts.append({"lat": 14.58085 + i * 1.5e-5, "lon": 121.0,
+                        "time": t}); t += 7
+        for la in np.linspace(14.5810, 14.5818, 5):
+            pts.append({"lat": float(la), "lon": 121.0, "time": t}); t += 3
+        out = self._match(net, pts)
+        for s in out["segments"]:
+            assert s["queue_length"] == 0
+
+    def test_far_from_end_stall_reports_no_queue(self, net):
+        # stall early in the segment (>100 m from its end): the segment end
+        # was never observed, so no queue may be extrapolated
+        pts, t = [], 1500000000
+        for la in np.linspace(14.5800, 14.5805, 4):
+            pts.append({"lat": float(la), "lon": 121.0, "time": t}); t += 3
+        for i in range(4):
+            pts.append({"lat": 14.58052 + i * 1.5e-5, "lon": 121.0,
+                        "time": t}); t += 7
+        out = self._match(net, pts)
+        for s in out["segments"]:
+            assert s["queue_length"] == 0
+
+    def test_offnetwork_tail_reports_no_queue(self, net):
+        # trailing points with no candidates (vehicle left the mapped
+        # network) must not be mistaken for a stalled queue
+        pts, t = [], 1500000000
+        for la in np.linspace(14.5800, 14.58145, 8):
+            pts.append({"lat": float(la), "lon": 121.0, "time": t}); t += 3
+        for i in range(4):  # far off any road, minutes of dwell
+            pts.append({"lat": 14.60, "lon": 121.05, "time": t}); t += 60
+        out = self._match(net, pts)
+        for s in out["segments"]:
+            assert s["queue_length"] == 0
