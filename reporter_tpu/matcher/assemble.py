@@ -222,7 +222,10 @@ def _chain_to_segments(net: RoadNetwork, chain: List[tuple],
     # at its last decoded position
     if trailing_dwell_s > 0.0 and runs:
         last_run = runs[-1]
-        bound_kph = interpolation_distance_m / trailing_dwell_s * 3.6
+        # tail points sit anywhere in a disc of one interpolation distance
+        # around the last kept point, so net displacement is bounded by the
+        # disc's diameter (2r), not its radius
+        bound_kph = 2.0 * interpolation_distance_m / trailing_dwell_s * 3.6
         if bound_kph < queue_threshold_kph and last_run.queue_start is None:
             last_run.queue_start = last_run.last_pos
 

@@ -34,7 +34,10 @@ import functools
 import jax
 import jax.numpy as jnp
 
-NEG_INF = jnp.float32(-1.0e30)
+# plain float, not a jnp scalar: a module-level jnp constant would
+# initialise the XLA backend at import time, which breaks
+# jax.distributed.initialize (parallel/multihost.py) — it must run first
+NEG_INF = -1.0e30
 NORMAL, RESTART, SKIP = 0, 1, 2
 # route distances at/above this threshold are "no route found within bound"
 UNREACHABLE_THRESHOLD = 0.5e9

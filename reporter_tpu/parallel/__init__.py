@@ -1,4 +1,6 @@
 from .mesh import make_mesh
+from .multihost import host_uuid_filter, init_multihost, partition_for_host
 from .sharded import sharded_viterbi, shard_batch
 
-__all__ = ["make_mesh", "sharded_viterbi", "shard_batch"]
+__all__ = ["make_mesh", "sharded_viterbi", "shard_batch",
+           "init_multihost", "partition_for_host", "host_uuid_filter"]

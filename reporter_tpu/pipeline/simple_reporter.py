@@ -58,6 +58,13 @@ MAX_ACCURACY_M = 1000  # reference: simple_reporter.py:112
 def _parse_part_file(path: str, valuer: Callable, time_pattern: str,
                      bbox: List[float], dest_dir: str) -> int:
     """Parse one downloaded part file into uuid-sharded trace files."""
+    # multi-host backfill: when REPORTER_TPU_NUM_PROCESSES/PROCESS_ID are
+    # set, each host keeps only its share of the uuid space, so N hosts
+    # pointed at the same --src partition the work instead of repeating it
+    # (the reference splits days across instances by hand,
+    # load-historical-data/README.md)
+    from ..parallel import host_uuid_filter
+    uuid_filter = host_uuid_filter()
     fast_time = time_pattern == "%Y-%m-%d %H:%M:%S"
     opener = gzip.open if path.endswith(".gz") else open
     shards: dict[str, list[str]] = {}
@@ -66,6 +73,9 @@ def _parse_part_file(path: str, valuer: Callable, time_pattern: str,
         for line in f:
             try:
                 uuid, tm, lat, lon, acc = valuer(line)
+                if uuid_filter is not None and \
+                        not uuid_filter(str(uuid)):
+                    continue
                 lat = float(lat)
                 lon = float(lon)
                 if lat < bbox[0] or lat > bbox[2] or \
@@ -420,6 +430,11 @@ def main(argv=None):
 
     logging.basicConfig(level=logging.INFO,
                         format="%(asctime)s %(levelname)s %(message)s")
+
+    # joins a multi-host JAX job when REPORTER_TPU_COORDINATOR etc. are
+    # set; single-host no-op otherwise
+    from ..parallel import init_multihost
+    init_multihost()
 
     from ..matcher import Configure, SegmentMatcher
 

@@ -194,6 +194,11 @@ def main(argv=None):
         sys.stderr.write(f"Problem with config file: {e}\n")
         return 1
 
+    # joins a multi-host JAX job when REPORTER_TPU_COORDINATOR etc. are
+    # set; single-host no-op otherwise
+    from ..parallel import init_multihost
+    init_multihost()
+
     service = ReporterService(SegmentMatcher())
     httpd = BoundedThreadingHTTPServer((host, port), make_handler(service))
     try:

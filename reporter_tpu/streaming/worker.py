@@ -75,8 +75,14 @@ class StreamWorker:
                  flush_interval_s: float = 3600.0,
                  session_gap_ms: int = SESSION_GAP_MS,
                  clock=time.time,
-                 state=None):
+                 state=None,
+                 uuid_filter: Optional[Callable[[str], bool]] = None):
         self.formatter = formatter
+        # multi-host: predicate deciding which uuids this worker owns
+        # (parallel.multihost — the Kafka keyed-partition contract when the
+        # input stream is not already partitioned); None = own everything
+        self.uuid_filter = uuid_filter
+        self.skipped_other_host = 0
         self.anonymiser = anonymiser
         self.batcher = PointBatcher(
             submit, lambda key, seg: self.anonymiser.process(key, seg),
@@ -105,6 +111,9 @@ class StreamWorker:
             self.parse_failures += 1
             if self.parse_failures % 1000 == 1:
                 logger.warning("Could not parse message: %r", message[:200])
+            return
+        if self.uuid_filter is not None and not self.uuid_filter(uuid):
+            self.skipped_other_host += 1
             return
         self.batcher.process(uuid, point, now_ms)
         self.processed += 1
@@ -181,6 +190,14 @@ def main(argv=None):
     logging.basicConfig(level=logging.INFO,
                         format="%(asctime)s %(levelname)s %(message)s")
 
+    # joins a multi-host JAX job when REPORTER_TPU_COORDINATOR etc. are
+    # set; single-host no-op otherwise. The uuid filter makes N workers
+    # reading one shared (unpartitioned) stream process each uuid exactly
+    # once — Kafka's keyed-partition contract without Kafka.
+    from ..parallel import host_uuid_filter, init_multihost
+    init_multihost()
+    uuid_filter = host_uuid_filter()
+
     if args.reporter_url:
         submit = http_submitter(args.reporter_url)
     else:
@@ -203,7 +220,8 @@ def main(argv=None):
         Anonymiser(TileSink(args.output_location), args.privacy,
                    args.quantisation, mode=args.mode, source=args.source),
         mode=args.mode, reports=args.reports, transitions=args.transitions,
-        flush_interval_s=args.flush_interval, state=state)
+        flush_interval_s=args.flush_interval, state=state,
+        uuid_filter=uuid_filter)
 
     if args.bootstrap:
         from .broker import KafkaBroker
