@@ -50,8 +50,10 @@ def init_multihost(coordinator_address: Optional[str] = None,
     # no coordinator -> no JAX multi-controller job. NUM_PROCESSES /
     # PROCESS_ID alone still partition the uuid space (host_uuid_filter):
     # N *independent* workers splitting one stream need no collectives and
-    # no coordinator.
-    if coordinator_address is None:
+    # no coordinator. The standard JAX cluster envs opt in too —
+    # jax.distributed.initialize auto-detects them when called.
+    if coordinator_address is None \
+            and not os.environ.get("JAX_COORDINATOR_ADDRESS"):
         return False
 
     import jax

@@ -35,6 +35,12 @@ import hashlib
 import logging
 import math
 import multiprocessing
+
+# worker processes are spawned, not forked: the parent may already run
+# JAX's (and jax.distributed's) native threads, and forking a
+# multi-threaded process can deadlock the children mid-mutex. Spawned
+# children re-import this module, which initialises no XLA backend.
+_MP = multiprocessing.get_context("spawn")
 import os
 import re
 import shutil
@@ -140,7 +146,7 @@ def gather_traces(src: str, key_regex: str, valuer_src: str,
     for chunk in chunks:
         if not chunk:
             continue
-        p = multiprocessing.Process(
+        p = _MP.Process(
             target=_gather_worker,
             args=(chunk, valuer_src, time_pattern, bbox, dest_dir))
         p.start()
@@ -183,17 +189,52 @@ def _download_s3(url: str, key_regex: str) -> List[str]:
 # stage 2: match (batched on device)
 # --------------------------------------------------------------------------
 
-def _windows_of(points: List[dict], inactivity: int) -> Iterable[List[dict]]:
+# longest window sent to the matcher in one request: the largest padding
+# bucket (batchpad.LENGTH_BUCKETS[-1]); longer active windows are chunked
+# with a trailing-holdback overlap instead of being truncated
+MAX_WINDOW_POINTS = 1024
+
+
+def _windows_of(points: List[dict], inactivity: int,
+                max_window: int = MAX_WINDOW_POINTS,
+                holdback_s: int = 15) -> Iterable[List[dict]]:
     """Split a uuid's points at gaps > ``inactivity`` seconds
-    (reference: simple_reporter.py:149-163)."""
+    (reference: simple_reporter.py:149-163).
+
+    Windows longer than ``max_window`` (the device's largest padding
+    bucket) are further split into chunks whose overlap covers the last
+    ``holdback_s`` seconds of the previous chunk — the same consumed-prefix
+    overlap the streaming path gets from ``shape_used`` trimming
+    (reference: Batch.java:73-76, reporter_service.py:89-92): report()
+    withholds segments inside the trailing holdback, and the next chunk
+    re-presents those points, so pairs at the seam are reported exactly
+    once with match context preserved.
+    """
+    def chunked(w: List[dict]) -> Iterable[List[dict]]:
+        while len(w) > max_window:
+            chunk = w[:max_window]
+            yield chunk
+            end_t = chunk[-1]["time"]
+            j = max_window - 1
+            while j > 0 and end_t - w[j]["time"] <= holdback_s:
+                j -= 1
+            # progress floor: a pathological burst (>max_window points
+            # inside one holdback span) must not degrade to 1-point steps
+            # and ~N chunks; advancing at least half a window caps the
+            # re-presented overlap at 2x total work
+            j = max(max_window // 2, min(j, max_window - 1))
+            w = w[j:]
+        if len(w) >= 2:
+            yield w
+
     start = 0
     for i in range(1, len(points)):
         if points[i]["time"] - points[i - 1]["time"] > inactivity:
             if i - start >= 2:
-                yield points[start:i]
+                yield from chunked(points[start:i])
             start = i
     if len(points) - start >= 2:
-        yield points[start:]
+        yield from chunked(points[start:])
 
 
 def match_traces(trace_dir: str, matcher, mode: str,
@@ -230,17 +271,26 @@ def match_traces(trace_dir: str, matcher, mode: str,
                     except ValueError:
                         continue
 
-        # build every window request in this shard up front
+        # build every window request in this shard up front. The chunker's
+        # holdback must equal report()'s threshold: report withholds
+        # segments starting within threshold_sec of a chunk's end, and the
+        # next chunk re-presents exactly that span
         requests = []
         for uuid, points in by_uuid.items():
             points.sort(key=lambda p: p["time"])
-            for window in _windows_of(points, inactivity):
+            for window in _windows_of(points, inactivity,
+                                      holdback_s=threshold_sec):
                 requests.append({
                     "uuid": uuid, "trace": window,
                     "match_options": {"mode": mode},
                 })
 
         tiles: dict[str, list[str]] = {}
+        # exactly-once across chunk seams: a uuid's windows are processed
+        # in time order, and pair start times are strictly increasing along
+        # a trace, so dropping reports at or below the uuid's
+        # highest-emitted t0 removes seam duplicates (and nothing else)
+        last_t0: dict[str, float] = {}
         for lo in range(0, len(requests), device_batch):
             chunk = requests[lo:lo + device_batch]
             try:
@@ -256,6 +306,14 @@ def match_traces(trace_dir: str, matcher, mode: str,
                     logger.error("Failed to report trace with uuid %s "
                                  "from file %s", trace["uuid"], shard)
                     continue
+                floor = last_t0.get(trace["uuid"])
+                reports = rep["datastore"]["reports"]
+                if floor is not None:
+                    reports = [r for r in reports if r["t0"] > floor]
+                    rep["datastore"]["reports"] = reports
+                if reports:
+                    last_t0[trace["uuid"]] = max(
+                        r["t0"] for r in reports)
                 _emit_rows(rep, trace, quantisation, source, mode, tiles)
         for tile_file, rows in tiles.items():
             path = os.path.join(dest_dir, tile_file)
@@ -372,7 +430,7 @@ def report_tiles(match_dir: str, dest: str, privacy: int,
     for chunk in chunks:
         if not chunk:
             continue
-        p = multiprocessing.Process(
+        p = _MP.Process(
             target=_report_worker, args=(chunk, match_dir, dest, privacy))
         p.start()
         procs.append(p)
@@ -431,11 +489,6 @@ def main(argv=None):
     logging.basicConfig(level=logging.INFO,
                         format="%(asctime)s %(levelname)s %(message)s")
 
-    # joins a multi-host JAX job when REPORTER_TPU_COORDINATOR etc. are
-    # set; single-host no-op otherwise
-    from ..parallel import init_multihost
-    init_multihost()
-
     from ..matcher import Configure, SegmentMatcher
 
     from ..utils import metrics
@@ -450,6 +503,13 @@ def main(argv=None):
                                       args.src_valuer, args.src_time_pattern,
                                       args.bbox, args.concurrency)
     if not match_dir:
+        # joins a multi-host JAX job when a coordinator is configured;
+        # single-host no-op otherwise. Deliberately AFTER the gather stage
+        # (which needs no devices) so the coordinator rendezvous doesn't
+        # gate pure-IO work; worker processes are spawned (_MP above), so
+        # jax.distributed's threads are never inherited mid-state either.
+        from ..parallel import init_multihost
+        init_multihost()
         Configure(args.match_config)
         matcher = SegmentMatcher()
         with metrics.timer("pipeline.match"):

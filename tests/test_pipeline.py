@@ -108,3 +108,45 @@ class TestPipelineEndToEnd:
             body = f.read()
         assert body.count("5,6,") == 3
         assert "7,8," not in body
+
+
+class TestLongWindowChunking:
+    """Windows beyond the largest padding bucket are chunked with a
+    holdback overlap rather than truncated."""
+
+    def _points(self, n, dt=1):
+        return [{"time": 1500000000 + i * dt, "lat": 14.0 + i * 1e-4,
+                 "lon": 121.0} for i in range(n)]
+
+    def test_short_window_untouched(self):
+        from reporter_tpu.pipeline.simple_reporter import _windows_of
+        pts = self._points(500)
+        ws = list(_windows_of(pts, inactivity=120))
+        assert len(ws) == 1 and len(ws[0]) == 500
+
+    def test_long_window_chunks_cover_all_points(self):
+        from reporter_tpu.pipeline.simple_reporter import (
+            MAX_WINDOW_POINTS, _windows_of)
+        pts = self._points(2500)
+        ws = list(_windows_of(pts, inactivity=120))
+        assert all(len(w) <= MAX_WINDOW_POINTS for w in ws)
+        covered = {p["time"] for w in ws for p in w}
+        assert covered == {p["time"] for p in pts}
+
+    def test_chunk_overlap_spans_holdback(self):
+        from reporter_tpu.pipeline.simple_reporter import _windows_of
+        pts = self._points(2500)
+        ws = list(_windows_of(pts, inactivity=120, holdback_s=15))
+        for a, b in zip(ws[:-1], ws[1:]):
+            overlap_start = b[0]["time"]
+            assert a[-1]["time"] - overlap_start > 15  # covers holdback
+            assert overlap_start > a[0]["time"]        # but makes progress
+
+    def test_inactivity_split_still_applies(self):
+        from reporter_tpu.pipeline.simple_reporter import _windows_of
+        pts = self._points(100)
+        pts[50]["time"] += 1000  # gap
+        for p in pts[51:]:
+            p["time"] += 1000
+        ws = list(_windows_of(pts, inactivity=120))
+        assert len(ws) == 2
