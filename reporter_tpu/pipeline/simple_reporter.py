@@ -509,6 +509,8 @@ def main(argv=None):
         # gate pure-IO work; worker processes are spawned (_MP above), so
         # jax.distributed's threads are never inherited mid-state either.
         from ..parallel import init_multihost
+        from ..utils.runtime import ensure_backend
+        ensure_backend()
         init_multihost()
         Configure(args.match_config)
         matcher = SegmentMatcher()

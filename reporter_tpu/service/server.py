@@ -194,6 +194,11 @@ def main(argv=None):
         sys.stderr.write(f"Problem with config file: {e}\n")
         return 1
 
+    # pin the JAX platform before any decode can block on a chip tunnel
+    # (REPORTER_TPU_PLATFORM=cpu|accel|auto; auto probes then falls back)
+    from ..utils.runtime import ensure_backend
+    ensure_backend()
+
     # joins a multi-host JAX job when REPORTER_TPU_COORDINATOR etc. are
     # set; single-host no-op otherwise
     from ..parallel import init_multihost

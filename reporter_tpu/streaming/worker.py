@@ -190,6 +190,13 @@ def main(argv=None):
     logging.basicConfig(level=logging.INFO,
                         format="%(asctime)s %(levelname)s %(message)s")
 
+    # pin the JAX platform before any decode can block on a chip tunnel;
+    # only the in-process matching path touches devices, but deciding up
+    # front keeps startup latency out of the first batch flush
+    if not args.reporter_url:
+        from ..utils.runtime import ensure_backend
+        ensure_backend()
+
     # joins a multi-host JAX job when REPORTER_TPU_COORDINATOR etc. are
     # set; single-host no-op otherwise. The uuid filter makes N workers
     # reading one shared (unpartitioned) stream process each uuid exactly

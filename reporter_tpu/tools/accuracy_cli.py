@@ -100,6 +100,11 @@ def main(argv=None):
 
     from ..matcher import SegmentMatcher
     from ..synth import build_grid_city, generate_trace
+    from ..utils.runtime import ensure_backend
+
+    # pin the JAX platform before the first decode (probe + CPU fallback;
+    # REPORTER_TPU_PLATFORM=cpu skips the probe entirely)
+    ensure_backend()
 
     if args.graph:
         from ..graph.network import RoadNetwork

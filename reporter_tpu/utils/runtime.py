@@ -1,0 +1,247 @@
+"""Process-level JAX backend isolation and selection.
+
+The deployment environment registers an accelerator PJRT plugin in every
+interpreter (via sitecustomize) before any of this package's code runs,
+and initialising that backend *blocks* until the chip tunnel is up. Three
+process roles need three different behaviours:
+
+- tests + the multi-chip dryrun must NEVER touch the chip: they force the
+  virtual multi-device CPU mesh by popping the non-CPU PJRT backend
+  factories before the first backend resolution (``force_virtual_cpu``).
+- the benchmark prefers the real chip but with bounded patience: it
+  probes the accelerator in a SUBPROCESS (``accelerator_available``) —
+  a blocked in-process init would hold xla_bridge's backend lock forever
+  and poison any later CPU fallback — and falls back to CPU when the
+  chip doesn't come up.
+- services and tools want the same auto behaviour, overridable with
+  ``REPORTER_TPU_PLATFORM=cpu|accel|auto`` (``ensure_backend``).
+
+This replaces per-entry-point copies of the isolation logic that used to
+live only in tests/conftest.py; every CLI front door calls through here.
+
+Reference analog: the reference binds to its native matcher at process
+start (reporter_service.py:284 ``valhalla.Configure``) and simply dies
+if the library is missing — here backend availability is dynamic, so
+the equivalent "configure" step needs a probe + fallback.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import re
+import subprocess
+import sys
+import threading
+
+log = logging.getLogger(__name__)
+
+ENV_PLATFORM = "REPORTER_TPU_PLATFORM"          # cpu | accel | auto
+ENV_VIRTUAL_DEVICES = "REPORTER_TPU_VIRTUAL_DEVICES"
+_DEVICE_COUNT_FLAG = "xla_force_host_platform_device_count"
+
+_decided: str | None = None  # this process's platform decision, once made
+
+
+def _backends_initialized():
+    from jax._src import xla_bridge
+    return bool(getattr(xla_bridge, "_backends", None))
+
+
+def force_virtual_cpu(n_devices: int | None = None) -> None:
+    """Pin this process to the CPU backend, optionally as a virtual
+    ``n_devices``-device mesh. Must run before the first jax backend
+    resolution; safe to call repeatedly.
+
+    Mechanics (mirrors tests/conftest.py): set both the env var and the
+    live config (jax may already be imported by sitecustomize, so the
+    env var alone can be too late), import pallas first (it registers
+    MLIR lowerings for the "tpu" platform at import time, which fails
+    once the factory is gone), then pop every non-CPU PJRT factory so
+    not even backend *enumeration* can touch the chip tunnel.
+    """
+    global _decided
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    if n_devices is not None:
+        flags = os.environ.get("XLA_FLAGS", "")
+        want = f"--{_DEVICE_COUNT_FLAG}={n_devices}"
+        if _DEVICE_COUNT_FLAG in flags:
+            # a stale/smaller pre-set count would silently under-provision
+            # the mesh — override it with the requested count
+            flags = re.sub(rf"--{_DEVICE_COUNT_FLAG}=\d+", want, flags)
+        else:
+            flags = (flags + " " + want).strip()
+        os.environ["XLA_FLAGS"] = flags
+
+    import jax
+    from jax._src import xla_bridge
+
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        from jax.experimental import pallas as _pl  # noqa: F401
+        from jax.experimental.pallas import tpu as _pltpu  # noqa: F401
+    except Exception:  # pragma: no cover - pallas optional at this point
+        pass
+    for name in list(xla_bridge._backend_factories):
+        if name != "cpu":
+            xla_bridge._backend_factories.pop(name, None)
+
+    if _backends_initialized():
+        if jax.default_backend() != "cpu":
+            raise RuntimeError(
+                "force_virtual_cpu called after a non-CPU backend was "
+                f"already initialised ({jax.default_backend()}); call it "
+                "before any jax.devices()/jit use in the process")
+        if n_devices is not None and len(jax.devices()) < n_devices:
+            raise RuntimeError(
+                f"CPU backend already initialised with {len(jax.devices())} "
+                f"devices; {n_devices} requested — the device-count flag "
+                "only takes effect before the first backend init")
+    _decided = "cpu"
+
+
+def accelerator_available(timeout_s: float = 90.0, tries: int = 2) -> bool:
+    """Probe whether the registered accelerator backend can initialise,
+    without risking this process.
+
+    The probe runs ``jax.devices()`` in a child interpreter (inheriting
+    the environment, so the same sitecustomize plugin registration
+    applies) under a hard timeout. A blocked init in *this* process
+    would wedge xla_bridge's backend lock and take the CPU fallback
+    down with it — hence the subprocess.
+
+    A child that comes up on plain "cpu" (e.g. JAX_PLATFORMS unset, no
+    working plugin) is NOT evidence of an accelerator: the parent then
+    takes the forced-CPU path, whose factory-popping guarantees an
+    unconstrained init can't still block on a half-working plugin.
+    """
+    code = ("import jax; d = jax.devices(); "
+            "print(d[0].platform); "
+            "import sys; sys.exit(0 if d else 1)")
+    for attempt in range(1, tries + 1):
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", code],
+                capture_output=True, text=True, timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            log.warning("accelerator probe %d/%d timed out after %.0fs",
+                        attempt, tries, timeout_s)
+            continue
+        lines = proc.stdout.strip().splitlines() if proc.stdout else []
+        platform = lines[-1] if lines else ""
+        if proc.returncode == 0 and platform and platform != "cpu":
+            log.info("accelerator probe ok: platform=%s", platform)
+            return True
+        if proc.returncode == 0:
+            log.info("probe came up on %r — no accelerator", platform)
+            return False
+        log.warning("accelerator probe %d/%d failed rc=%d: %s",
+                    attempt, tries, proc.returncode,
+                    proc.stderr.strip()[-300:])
+    return False
+
+
+def ensure_backend(prefer: str | None = None,
+                   n_virtual_devices: int | None = None,
+                   probe_timeout_s: float = 90.0,
+                   probe_tries: int = 2) -> str:
+    """Decide and pin this process's JAX platform. Returns "cpu" or the
+    accelerator platform name.
+
+    Order of authority: explicit ``prefer`` arg, then the
+    ``REPORTER_TPU_PLATFORM`` env var, then "auto". "auto" probes the
+    accelerator (subprocess, bounded) and falls back to the virtual CPU
+    mesh. A CPU decision is exported back into ``REPORTER_TPU_PLATFORM``
+    so child processes (pipeline stage fan-out) skip re-probing; an
+    accelerator decision is NOT exported — "accel" in a child means an
+    unbounded blocking init while the parent holds the single chip, so
+    children re-run the bounded auto probe instead.
+    """
+    global _decided
+    if _decided is not None:
+        return _decided
+
+    choice = (prefer or os.environ.get(ENV_PLATFORM) or "auto").lower()
+    if n_virtual_devices is None:
+        env_n = os.environ.get(ENV_VIRTUAL_DEVICES)
+        n_virtual_devices = int(env_n) if env_n else None
+
+    if choice == "cpu":
+        force_virtual_cpu(n_virtual_devices)
+        os.environ[ENV_PLATFORM] = "cpu"
+        return "cpu"
+
+    if choice in ("accel", "tpu"):
+        import jax
+        platform = jax.devices()[0].platform  # may block; caller opted in
+        _decided = platform
+        os.environ[ENV_PLATFORM] = "accel"
+        return platform
+
+    if choice != "auto":
+        raise ValueError(f"unknown {ENV_PLATFORM} value {choice!r}")
+
+    if _backends_initialized():
+        import jax
+        _decided = jax.default_backend()
+        if _decided == "cpu":
+            os.environ[ENV_PLATFORM] = "cpu"
+        return _decided
+
+    if accelerator_available(timeout_s=probe_timeout_s, tries=probe_tries):
+        try:
+            platform = _init_accel_or_reexec(timeout_s=2 * probe_timeout_s)
+        except RuntimeError as e:
+            log.warning("%s; falling back to CPU backend", e)
+        else:
+            _decided = platform
+            # deliberately NOT exported as "accel": a child inheriting
+            # "accel" would take the unbounded-blocking explicit branch
+            # while the parent holds the chip. Children re-probe under
+            # "auto", which is bounded (and fails fast to CPU while the
+            # chip is held).
+            return platform
+
+    log.warning("accelerator unavailable; falling back to CPU backend")
+    force_virtual_cpu(n_virtual_devices)
+    os.environ[ENV_PLATFORM] = "cpu"
+    return "cpu"
+
+
+def _init_accel_or_reexec(timeout_s: float) -> str:
+    """Initialise the accelerator in-process, with a last-resort escape.
+
+    The subprocess probe just succeeded, so this init overwhelmingly
+    succeeds too — but the tunnel can flake in the window between probe
+    and init, and a blocked in-process init is unrecoverable (it wedges
+    xla_bridge's backend lock, so no CPU fallback is possible in this
+    interpreter). The escape: run the init on a watcher-timed thread and,
+    on timeout, re-exec the whole process with REPORTER_TPU_PLATFORM=cpu
+    so the restarted interpreter takes the forced-CPU path from scratch.
+    ensure_backend runs at entry-point startup before any real work, so
+    re-exec loses nothing but the probe time.
+    """
+    done = threading.Event()
+    result: dict = {}
+
+    def _init():
+        try:
+            import jax
+            result["platform"] = jax.devices()[0].platform
+        except Exception as e:  # init failed fast — fall back, not re-exec
+            result["error"] = e
+        done.set()
+
+    t = threading.Thread(target=_init, daemon=True, name="jax-accel-init")
+    t.start()
+    if done.wait(timeout_s):
+        if "platform" in result:
+            return result["platform"]
+        raise RuntimeError(
+            f"accelerator init failed after successful probe: "
+            f"{result['error']!r}")
+    log.error("accelerator init blocked >%.0fs after a successful probe; "
+              "re-executing on the CPU backend", timeout_s)
+    os.environ[ENV_PLATFORM] = "cpu"
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os.execvp(sys.orig_argv[0], sys.orig_argv)

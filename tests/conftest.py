@@ -8,31 +8,25 @@ Two hazards specific to this environment:
   conftest runs; merely setting JAX_PLATFORMS=cpu still initialises that
   backend (and blocks on the chip tunnel), so the factory is removed from
   the registry outright.
+
+The mechanics live in reporter_tpu.utils.runtime.force_virtual_cpu — the
+same helper every CLI front door uses — so pytest and the shell harnesses
+share one copy of the isolation logic.
 """
 import os
+import sys
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import jax
-from jax._src import xla_bridge
+from reporter_tpu.utils.runtime import force_virtual_cpu  # noqa: E402
 
-# jax was already imported by sitecustomize, so the env var change above
-# came too late for its config — update it directly as well
-jax.config.update("jax_platforms", "cpu")
+force_virtual_cpu(8)
+# child processes spawned by tests (pipeline stages, multihost workers)
+# inherit the decision instead of re-probing the chip
+os.environ.setdefault("REPORTER_TPU_PLATFORM", "cpu")
+os.environ.setdefault("REPORTER_TPU_VIRTUAL_DEVICES", "8")
 
-# pallas registers MLIR lowering rules for the "tpu" platform at import
-# time, which fails once the factory below is popped — import it first
-# (tests then run pallas kernels in interpret mode on cpu)
-from jax.experimental import pallas as _pl  # noqa: F401,E402
-from jax.experimental.pallas import tpu as _pltpu  # noqa: F401,E402
-
-for _name in list(xla_bridge._backend_factories):
-    if _name != "cpu":
-        xla_bridge._backend_factories.pop(_name, None)
+import jax  # noqa: E402
 
 # fail loudly if the force-to-CPU mechanism ever stops working; tests must
 # never contend for the single real TPU chip (bench.py owns it)

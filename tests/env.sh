@@ -8,3 +8,7 @@ export FORMATTER=${FORMATTER:-',sv,\|,0,1,2,3,4'}
 export REPORT_LEVELS=${REPORT_LEVELS:-0,1,2}
 export TRANSITION_LEVELS=${TRANSITION_LEVELS:-0,1,2}
 export THRESHOLD_SEC=${THRESHOLD_SEC:-15}
+# test harnesses never contend for the real chip (conftest's rule, for
+# shell entry points): skip the accelerator probe, run on virtual CPU
+export REPORTER_TPU_PLATFORM=${REPORTER_TPU_PLATFORM:-cpu}
+export REPORTER_TPU_VIRTUAL_DEVICES=${REPORTER_TPU_VIRTUAL_DEVICES:-8}

@@ -1,0 +1,111 @@
+"""Backend isolation helper (utils/runtime.py).
+
+This is the round-2 fix for the round-1 driver failures: every non-pytest
+entry point used to hang on the chip-tunnel block because the isolation
+logic lived only in tests/conftest.py. These tests pin the helper's
+contract; conftest itself already exercises force_virtual_cpu for real
+(it is how this very suite runs on the virtual 8-CPU mesh).
+"""
+import os
+import subprocess
+import sys
+
+import jax
+
+from reporter_tpu.utils import runtime
+
+
+def test_force_virtual_cpu_idempotent():
+    # conftest already forced cpu; calling again must be a safe no-op
+    runtime.force_virtual_cpu(8)
+    runtime.force_virtual_cpu()
+    assert jax.default_backend() == "cpu"
+    assert len(jax.devices()) == 8
+
+
+def test_factories_are_popped():
+    from jax._src import xla_bridge
+    assert list(xla_bridge._backend_factories) == ["cpu"]
+
+
+def test_ensure_backend_env_cpu(monkeypatch):
+    monkeypatch.setattr(runtime, "_decided", None)
+    monkeypatch.setenv(runtime.ENV_PLATFORM, "cpu")
+    assert runtime.ensure_backend() == "cpu"
+
+
+def test_ensure_backend_caches_decision(monkeypatch):
+    monkeypatch.setattr(runtime, "_decided", "cpu")
+    # cached decision short-circuits before any probe or env read
+    monkeypatch.setenv(runtime.ENV_PLATFORM, "definitely-invalid")
+    assert runtime.ensure_backend() == "cpu"
+
+
+def test_ensure_backend_rejects_unknown(monkeypatch):
+    monkeypatch.setattr(runtime, "_decided", None)
+    monkeypatch.setenv(runtime.ENV_PLATFORM, "gpu3000")
+    import pytest
+    with pytest.raises(ValueError):
+        runtime.ensure_backend()
+
+
+def test_ensure_backend_auto_with_initialized_cpu(monkeypatch):
+    # backends are initialised (conftest ran jax on cpu): auto must not
+    # probe — it adopts the live backend
+    monkeypatch.setattr(runtime, "_decided", None)
+    monkeypatch.delenv(runtime.ENV_PLATFORM, raising=False)
+    called = []
+    monkeypatch.setattr(runtime, "accelerator_available",
+                        lambda **kw: called.append(1) or False)
+    assert runtime.ensure_backend() == "cpu"
+    assert not called
+
+
+def test_probe_cpu_child_is_not_an_accelerator(monkeypatch, tmp_path):
+    # a child that initialises on plain cpu must read as "no accelerator"
+    fake = tmp_path / "python"
+    fake.write_text("#!/bin/sh\necho cpu\nexit 0\n")
+    fake.chmod(0o755)
+    monkeypatch.setattr(runtime.sys, "executable", str(fake))
+    assert runtime.accelerator_available(timeout_s=5, tries=1) is False
+
+
+def test_probe_failure_then_success(monkeypatch, tmp_path):
+    marker = tmp_path / "tried"
+    fake = tmp_path / "python"
+    fake.write_text(
+        "#!/bin/sh\n"
+        f"if [ -e {marker} ]; then echo faketpu; exit 0; fi\n"
+        f"touch {marker}\nexit 1\n")
+    fake.chmod(0o755)
+    monkeypatch.setattr(runtime.sys, "executable", str(fake))
+    assert runtime.accelerator_available(timeout_s=5, tries=2) is True
+
+
+def test_probe_timeout(monkeypatch, tmp_path):
+    fake = tmp_path / "python"
+    fake.write_text("#!/bin/sh\nsleep 30\n")
+    fake.chmod(0o755)
+    monkeypatch.setattr(runtime.sys, "executable", str(fake))
+    assert runtime.accelerator_available(timeout_s=1, tries=1) is False
+
+
+def test_fresh_process_force_cpu_never_touches_plugin():
+    # end-to-end in a clean interpreter: the registered accelerator
+    # plugin (which blocks on its tunnel in this environment) must never
+    # be initialised when the helper forces cpu first
+    code = (
+        "from reporter_tpu.utils.runtime import force_virtual_cpu\n"
+        "force_virtual_cpu(4)\n"
+        "import jax\n"
+        "assert jax.default_backend() == 'cpu'\n"
+        "assert len(jax.devices()) == 4\n"
+        "print('ok')\n")
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    proc = subprocess.run([sys.executable, "-c", code], env=env,
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr[-500:]
+    assert proc.stdout.strip().endswith("ok")
