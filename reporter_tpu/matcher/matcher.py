@@ -136,7 +136,7 @@ class SegmentMatcher:
 
         # deferred: importing at module level would cycle through
         # ops -> pallas_viterbi -> matcher.hmm -> matcher/__init__
-        from ..ops import decode_batch
+        from ..ops import batch_pad_multiple, decode_batch
 
         # sigma/beta are batch-wide scalars on device, so traces may only
         # share a batch when their scoring params agree — group first, then
@@ -152,9 +152,16 @@ class SegmentMatcher:
         # later chunks overlap host-side work on earlier ones (the h2d copy
         # is the bottleneck on tunneled chips, not the decode itself)
         chunk = _decode_chunk()
+        # pad the batch dim to the mesh's data-axis size so decode_batch
+        # takes the sharded multi-device path (filler rows are all-SKIP
+        # traces that decode to nothing)
+        pad = batch_pad_multiple()
+        if pad:
+            chunk = ((chunk + pad - 1) // pad) * pad
         pending = []
         for (sigma, beta), group in groups.items():
-            for batch in pack_batches(group, max_batch=chunk):
+            for batch in pack_batches(group, pad_batch_to=pad,
+                                      max_batch=chunk):
                 decoded, _scores = decode_batch(
                     batch.dist_m, batch.valid, batch.route_m, batch.gc_m,
                     batch.case, np.float32(sigma), np.float32(beta))

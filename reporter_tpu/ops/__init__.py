@@ -9,11 +9,10 @@ Three implementations of the batched Viterbi decode, one contract:
           work and launches on TPU hardware
 
 ``decode_batch`` picks per call: honours REPORTER_TPU_DECODE
-(scan|assoc|pallas) when set; otherwise assoc. Measured on one TPU chip at
-(B=512, T=64, K=8): end-to-end service throughput is identical across the
-three (~2250 traces/s — host-side segment assembly dominates); device-
-resident decode favours assoc (~26 ms vs ~64 ms for scan/pallas per 512
-traces), so assoc is the default and pallas stays opt-in until it wins.
+(scan|assoc|pallas) when set; otherwise assoc — the only backend that is
+both log-depth and seq-shardable, and the one the recorded benchmarks
+(BENCH_r*.json, produced by bench.py) measure. pallas stays opt-in until
+a recorded run shows it winning on hardware.
 """
 import os
 
@@ -27,7 +26,7 @@ from .pallas_viterbi import (
 )
 
 __all__ = ["viterbi_assoc_batch", "viterbi_pallas_batch", "step_matrices",
-           "decode_batch"]
+           "decode_batch", "batch_pad_multiple"]
 
 
 def decode_backend(T: int, K: int) -> str:
@@ -39,21 +38,81 @@ def decode_backend(T: int, K: int) -> str:
     return "assoc"
 
 
+# process-default sharded decode, built lazily on first use: (run, data, seq)
+# or (None, 1, 1) on a single device / when disabled
+_sharded_cache = None
+
+
+def _sharded_run():
+    """The process-default mesh decode, the production multi-device path.
+
+    Built once from the visible devices: a (data, seq) mesh — data shards
+    the trace batch (the reference's uuid-partition scale-out axis,
+    SURVEY.md §2.4), seq optionally shards the time axis
+    (REPORTER_TPU_SEQ_SHARDS, default 1). REPORTER_TPU_SHARD=0 disables.
+    """
+    global _sharded_cache
+    if _sharded_cache is None:
+        if os.environ.get("REPORTER_TPU_SHARD", "1").lower() in (
+                "0", "off", "false"):
+            _sharded_cache = (None, 1, 1)
+            return _sharded_cache
+        n = len(jax.devices())
+        if n <= 1:
+            _sharded_cache = (None, 1, 1)
+            return _sharded_cache
+        try:
+            seq = max(1, int(os.environ.get("REPORTER_TPU_SEQ_SHARDS", "1")))
+        except ValueError:
+            seq = 1
+        seq = min(seq, n)
+        while n % seq:  # largest feasible seq <= requested
+            seq -= 1
+        data = n // seq
+        from ..parallel.mesh import make_mesh
+        from ..parallel.sharded import sharded_viterbi
+        mesh = make_mesh((data, seq))
+        _sharded_cache = (sharded_viterbi(mesh), data, seq)
+    return _sharded_cache
+
+
+def batch_pad_multiple():
+    """Batch-dim multiple callers should pad to so ``decode_batch`` can
+    take the sharded path (the mesh's data-axis size); None when decode is
+    single-device. match_many feeds this to pack_batches(pad_batch_to=...).
+
+    Only the assoc backend shards, so a forced scan/pallas backend means
+    padding would buy nothing — report None and skip it."""
+    forced = os.environ.get("REPORTER_TPU_DECODE", "").strip().lower()
+    if forced in ("scan", "pallas"):
+        return None
+    run, data, _seq = _sharded_run()
+    return data if run is not None else None
+
+
 def decode_batch(dist_m, valid, route_m, gc_m, case, sigma, beta):
     """Backend-dispatched batched Viterbi decode; same contract as
     matcher.hmm.viterbi_decode_batch.
 
     Accepts f32 tensors or the f16 wire format (built by
     matcher.batchpad.pack_batches, the single owner of the wire policy) —
-    the scoring kernels upcast on device either way."""
+    the scoring kernels upcast on device either way.
+
+    With more than one visible device, batches whose dims divide the
+    process mesh run sharded (data-parallel over traces, optionally
+    sequence-parallel over time); others fall through to single-device."""
     backend = decode_backend(T=dist_m.shape[1], K=dist_m.shape[2])
+    if backend == "assoc":
+        run, data, seq = _sharded_run()
+        B, T = dist_m.shape[0], dist_m.shape[1]
+        if run is not None and B % data == 0 and T % seq == 0:
+            return run(dist_m, valid, route_m, gc_m, case, sigma, beta)
+        return viterbi_assoc_batch(dist_m, valid, route_m, gc_m, case,
+                                   sigma, beta)
     if backend == "pallas":
         interpret = jax.default_backend() != "tpu"
         return viterbi_pallas_batch(dist_m, valid, route_m, gc_m, case,
                                     sigma, beta, interpret=interpret)
-    if backend == "assoc":
-        return viterbi_assoc_batch(dist_m, valid, route_m, gc_m, case,
-                                   sigma, beta)
     from ..matcher.hmm import viterbi_decode_batch
     return viterbi_decode_batch(dist_m, valid, route_m, gc_m, case,
                                 sigma, beta)

@@ -174,7 +174,8 @@ def ensure_backend(prefer: str | None = None,
         import jax
         platform = jax.devices()[0].platform  # may block; caller opted in
         _decided = platform
-        os.environ[ENV_PLATFORM] = "accel"
+        # NOT exported (module invariant): a child inheriting "accel"
+        # would block unbounded while this parent holds the single chip
         return platform
 
     if choice != "auto":

@@ -22,9 +22,10 @@ from reporter_tpu.utils.runtime import force_virtual_cpu  # noqa: E402
 
 force_virtual_cpu(8)
 # child processes spawned by tests (pipeline stages, multihost workers)
-# inherit the decision instead of re-probing the chip
-os.environ.setdefault("REPORTER_TPU_PLATFORM", "cpu")
-os.environ.setdefault("REPORTER_TPU_VIRTUAL_DEVICES", "8")
+# inherit the decision instead of re-probing the chip. Unconditional: a
+# pre-set =accel in the operator's shell must not leak into test children
+os.environ["REPORTER_TPU_PLATFORM"] = "cpu"
+os.environ["REPORTER_TPU_VIRTUAL_DEVICES"] = "8"
 
 import jax  # noqa: E402
 
