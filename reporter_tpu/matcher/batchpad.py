@@ -209,9 +209,14 @@ def _f16_safe(p: PreparedTrace) -> bool:
     return True
 
 
+def _next_pow2(n: int) -> int:
+    return 1 << max(n - 1, 0).bit_length()
+
+
 def pack_batches(prepared: Sequence[PreparedTrace],
                  pad_batch_to: int | None = None,
-                 max_batch: int | None = None) -> List[PaddedBatch]:
+                 max_batch: int | None = None,
+                 pad_pow2: bool = False) -> List[PaddedBatch]:
     """Group prepared traces by bucket length and stack into batches.
 
     ``pad_batch_to`` optionally rounds the batch dimension up to a multiple
@@ -220,6 +225,11 @@ def pack_batches(prepared: Sequence[PreparedTrace],
     splits a group into chunks of at most that many traces so host->device
     transfer, decode, and host post-processing of successive chunks can
     overlap (the dispatch pipeline in SegmentMatcher.match_many).
+    ``pad_pow2`` additionally rounds the batch dimension up to a power of
+    two (after the multiple), bounding the compiled-shape count per bucket
+    to log2(max_batch) instead of max_batch — a micro-batching service
+    sees every B from 1 to its flush cap over a long run, and each
+    distinct B is otherwise a fresh XLA compile stall.
 
     By default the float tensors are built in the f16 wire format — the
     cast happens inside the copy the pack already performs, halving
@@ -254,6 +264,10 @@ def pack_batches(prepared: Sequence[PreparedTrace],
         B = len(group)
         if pad:
             B = ((B + pad - 1) // pad) * pad
+        if pad_pow2:
+            B2 = _next_pow2(B)
+            if not pad or B2 % pad == 0:  # never break mesh divisibility
+                B = B2
         K = group[0].edge_ids.shape[1]
         with np.errstate(over="ignore"):  # sentinels overflow f16 to +inf
             dist = np.full((B, T, K), PAD_DIST, dtype=dtype)

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import json
 import os
+from concurrent.futures import ThreadPoolExecutor
 from typing import List, Optional, Sequence
 
 import numpy as np
@@ -38,6 +39,15 @@ def _decode_chunk() -> int:
         return max(1, int(os.environ.get("REPORTER_TPU_DECODE_CHUNK", 128)))
     except ValueError:
         return 128
+
+
+def _prep_workers() -> int:
+    """Host-prep thread count (env-tunable; 0 disables the pool)."""
+    try:
+        return int(os.environ.get("REPORTER_TPU_PREP_THREADS",
+                                  min(32, os.cpu_count() or 1)))
+    except ValueError:
+        return min(32, os.cpu_count() or 1)
 
 
 def Configure(conf) -> None:
@@ -93,6 +103,12 @@ class SegmentMatcher:
             elif use_native:
                 raise RuntimeError("native host runtime requested but "
                                    "unavailable")
+        # shared prep pool, created lazily on the first batched call.
+        # Safe for both prep paths: the C++ runtime releases the GIL and
+        # stripe-locks its route cache; the numpy path's RouteCache dict
+        # ops are atomic under the GIL (races cost a redundant dijkstra,
+        # never corruption).
+        self._prep_pool: Optional[ThreadPoolExecutor] = None
 
     @property
     def grid(self) -> SpatialGrid:
@@ -113,26 +129,55 @@ class SegmentMatcher:
         return json.dumps(result, separators=(",", ":"))
 
     # -- batched hot path --------------------------------------------------
+    def prepare(self, points: Sequence[dict],
+                params: Optional[MatchParams] = None):
+        """Host prep (candidates + route tensors) for one trace — the
+        single owner of the native-vs-numpy dispatch; bench and tests use
+        this instead of re-implementing the branch."""
+        params = params if params is not None else self.params
+        if self.runtime is not None:
+            return prepare_trace(self.net, None, points, params,
+                                 runtime=self.runtime)
+        return prepare_trace(self.net, self.grid, points, params,
+                             self.route_cache)
+
+    def _prepare_one(self, item):
+        """(index, trace, params) -> (index, PreparedTrace)."""
+        i, tr, params = item
+        return i, self.prepare(tr["trace"], params)
+
+    def _prep_map(self, items):
+        """Prepare a chunk of (index, trace, params), in parallel when the
+        native runtime is present. Host prep (candidates + bounded
+        Dijkstra) is the end-to-end ceiling, not the decode — this is
+        where the reference's 16-process fan-out
+        (simple_reporter.py:265-297) is matched, with threads against the
+        GIL-releasing, lock-striped C++ runtime instead of processes.
+        The pure-Python numpy fallback holds the GIL, so threads would
+        only add contention there — it stays serial."""
+        workers = _prep_workers()
+        if self.runtime is None or workers <= 1 or len(items) <= 1:
+            return [self._prepare_one(it) for it in items]
+        if self._prep_pool is None:
+            self._prep_pool = ThreadPoolExecutor(
+                max_workers=workers, thread_name_prefix="prep")
+        return list(self._prep_pool.map(self._prepare_one, items))
+
     def match_many(self, traces: Sequence[dict]) -> List[dict]:
         """Match a batch of trace dicts; returns match dicts in order.
 
         Each trace: {"uuid": ..., "trace": [{lat, lon, time, ...}, ...],
         "match_options": {...}} — per-trace match_options may override
         params (reference: generate_test_trace.py:45-52).
+
+        Three-stage pipeline per chunk: host prep on the thread pool,
+        async device decode dispatch, host assembly after the last
+        dispatch — so chunk N+1's prep overlaps chunk N's decode, and
+        decode of late chunks overlaps assembly of early ones.
         """
-        prepared = []
-        per_trace_params = []
-        for tr in traces:
-            params = self.params.with_options(tr.get("match_options", {}))
-            per_trace_params.append(params)
-            if self.runtime is not None:
-                prepared.append(prepare_trace(
-                    self.net, None, tr["trace"], params,
-                    runtime=self.runtime))
-            else:
-                prepared.append(prepare_trace(
-                    self.net, self.grid, tr["trace"], params,
-                    self.route_cache))
+        per_trace_params = [
+            self.params.with_options(tr.get("match_options", {}))
+            for tr in traces]
 
         # deferred: importing at module level would cycle through
         # ops -> pallas_viterbi -> matcher.hmm -> matcher/__init__
@@ -141,16 +186,11 @@ class SegmentMatcher:
         # sigma/beta are batch-wide scalars on device, so traces may only
         # share a batch when their scoring params agree — group first, then
         # bucket by length within each group
-        paths: dict[int, np.ndarray] = {}
-        index_of = {id(p): i for i, p in enumerate(prepared)}
         groups: dict[tuple, list] = {}
-        for p, params in zip(prepared, per_trace_params):
+        for i, (tr, params) in enumerate(zip(traces, per_trace_params)):
             key = (params.effective_sigma, params.beta)
-            groups.setdefault(key, []).append(p)
-        # two-phase dispatch: enqueue every chunk's decode + its async
-        # device->host copy before draining any, so transfer and compute of
-        # later chunks overlap host-side work on earlier ones (the h2d copy
-        # is the bottleneck on tunneled chips, not the decode itself)
+            groups.setdefault(key, []).append((i, tr, params))
+
         chunk = _decode_chunk()
         # pad the batch dim to the mesh's data-axis size so decode_batch
         # takes the sharded multi-device path (filler rows are all-SKIP
@@ -158,26 +198,41 @@ class SegmentMatcher:
         pad = batch_pad_multiple()
         if pad:
             chunk = ((chunk + pad - 1) // pad) * pad
+
+        # chunked pipeline: prep chunk (parallel) -> enqueue decode + async
+        # d2h copy -> prep next chunk while the device works. Nothing is
+        # drained until every chunk is dispatched, so h2d, decode and d2h of
+        # later chunks overlap host prep/assembly of earlier ones.
+        prepared: dict[int, object] = {}
         pending = []
-        for (sigma, beta), group in groups.items():
-            for batch in pack_batches(group, pad_batch_to=pad,
-                                      max_batch=chunk):
-                decoded, _scores = decode_batch(
-                    batch.dist_m, batch.valid, batch.route_m, batch.gc_m,
-                    batch.case, np.float32(sigma), np.float32(beta))
-                if hasattr(decoded, "copy_to_host_async"):
-                    decoded.copy_to_host_async()
-                pending.append((batch, decoded))
-        for batch, decoded in pending:
+        for (sigma, beta), items in groups.items():
+            for lo in range(0, len(items), chunk):
+                prepped = self._prep_map(items[lo:lo + chunk])
+                for i, p in prepped:
+                    prepared[i] = p
+                group = [p for _i, p in prepped]
+                order = [i for i, _p in prepped]
+                for batch in pack_batches(group, pad_batch_to=pad,
+                                          pad_pow2=True):
+                    decoded, _scores = decode_batch(
+                        batch.dist_m, batch.valid, batch.route_m,
+                        batch.gc_m, batch.case,
+                        np.float32(sigma), np.float32(beta))
+                    if hasattr(decoded, "copy_to_host_async"):
+                        decoded.copy_to_host_async()
+                    pending.append((batch, order, decoded))
+
+        paths: dict[int, np.ndarray] = {}
+        for batch, order, decoded in pending:
             decoded = np.asarray(decoded)
-            for b, ptrace in enumerate(batch.traces):
-                paths[index_of[id(ptrace)]] = decoded[b]
+            idx_of = {id(prepared[i]): i for i in order}
+            for b, p in enumerate(batch.traces):
+                paths[idx_of[id(p)]] = decoded[b]
 
         results = []
-        for i, (tr, ptrace) in enumerate(zip(traces, prepared)):
-            params = per_trace_params[i]
+        for i, (tr, params) in enumerate(zip(traces, per_trace_params)):
             results.append(assemble_segments(
-                self.net, ptrace, paths[i], mode=params.mode,
+                self.net, prepared[i], paths[i], mode=params.mode,
                 queue_threshold_kph=params.queue_speed_threshold_kph,
                 interpolation_distance_m=params.interpolation_distance))
         return results

@@ -7,9 +7,10 @@ framework silently stays on the numpy implementations in
 :mod:`reporter_tpu.graph` — same contract, slower.
 
 ctypes releases the GIL during calls, so multiple Python threads can
-prepare traces through one NativeRuntime concurrently; the underlying
-route cache is per-handle and calls into one handle must be serialised by
-the caller (SegmentMatcher owns exactly one).
+prepare traces through one NativeRuntime concurrently; the C++ route
+cache is lock-striped per source node (host_runtime.cpp), so concurrent
+rt_route_matrices calls on one shared handle are safe and scale across
+threads (SegmentMatcher owns one handle and preps on a thread pool).
 """
 from __future__ import annotations
 

@@ -14,6 +14,7 @@
 // structures (CSR, grid, caches).
 
 #include <algorithm>
+#include <array>
 #include <cmath>
 #include <cstdint>
 #include <cstring>
@@ -44,12 +45,24 @@ struct Graph {
   std::unordered_map<int64_t, std::vector<int32_t>> cells;
 
   // per-source-node bounded dijkstra cache: node -> (bound, dists).
-  // guarded by route_mu: ctypes releases the GIL, so concurrent
-  // rt_route_matrices calls on one handle must serialise here
-  std::unordered_map<int32_t,
-                     std::pair<float, std::unordered_map<int32_t, float>>>
-      route_cache;
-  std::mutex route_mu;
+  // Lock-STRIPED: ctypes releases the GIL, so many Python threads
+  // prepare traces through one handle concurrently; a whole-cache mutex
+  // would serialise them (it did, round 1). A search from src touches
+  // only src's entry, so striping by src keeps contention to threads
+  // racing on the same source node — where waiting is the right call
+  // anyway (the winner's cache entry saves the loser the search).
+  static constexpr int kStripes = 64;
+  struct CacheStripe {
+    std::unordered_map<int32_t,
+                       std::pair<float, std::unordered_map<int32_t, float>>>
+        map;
+    std::mutex mu;
+  };
+  std::array<CacheStripe, kStripes> route_stripes;
+
+  CacheStripe& stripe_for(int32_t src) {
+    return route_stripes[static_cast<uint32_t>(src) % kStripes];
+  }
 
   static int64_t cell_key(int64_t i, int64_t j) {
     // shift on the unsigned representation: << on negative values is UB
@@ -81,9 +94,13 @@ struct Graph {
     }
   }
 
-  // bounded single-source dijkstra over nodes; reuses/extends cache entries
+  // bounded single-source dijkstra over nodes; reuses/extends cache
+  // entries. Caller must hold stripe_for(src).mu for the whole call AND
+  // for as long as it reads the returned map (an extension to a larger
+  // bound move-assigns the mapped value, invalidating concurrent reads).
   const std::unordered_map<int32_t, float>& dists_from(int32_t src,
                                                        float bound) {
+    auto& route_cache = stripe_for(src).map;
     auto it = route_cache.find(src);
     if (it != route_cache.end() && it->second.first >= bound)
       return it->second.second;
@@ -141,14 +158,20 @@ void rt_graph_destroy(void* handle) { delete static_cast<Graph*>(handle); }
 
 void rt_cache_clear(void* handle) {
   auto* g = static_cast<Graph*>(handle);
-  std::lock_guard<std::mutex> lock(g->route_mu);
-  g->route_cache.clear();
+  for (auto& s : g->route_stripes) {
+    std::lock_guard<std::mutex> lock(s.mu);
+    s.map.clear();
+  }
 }
 
 int64_t rt_cache_size(void* handle) {
   auto* g = static_cast<Graph*>(handle);
-  std::lock_guard<std::mutex> lock(g->route_mu);
-  return static_cast<int64_t>(g->route_cache.size());
+  int64_t n = 0;
+  for (auto& s : g->route_stripes) {
+    std::lock_guard<std::mutex> lock(s.mu);
+    n += static_cast<int64_t>(s.map.size());
+  }
+  return n;
 }
 
 // K nearest edges within radius for each of T projected points.
@@ -234,8 +257,6 @@ void rt_route_matrices(void* handle, int64_t T, int32_t K,
                        const float* gc, double factor, double min_bound,
                        double backward_tol, float* out) {
   auto* g = static_cast<Graph*>(handle);
-  // serialise cache access; candidate lookup stays lock-free (read-only)
-  std::lock_guard<std::mutex> lock(g->route_mu);
   for (int64_t t = 0; t + 1 < T; ++t) {
     const float bound = static_cast<float>(
         std::max(min_bound, factor * static_cast<double>(gc[t])));
@@ -249,7 +270,11 @@ void rt_route_matrices(void* handle, int64_t T, int32_t K,
       const float oa = offsets[t * K + i];
       const float remaining = g->edge_len[ea] - oa;
       const int32_t src = g->edge_end[ea];
-      // one bounded search from ea's end node covers every target j
+      // one bounded search from ea's end node covers every target j.
+      // The stripe lock is held across compute AND the row fill below:
+      // a concurrent bound-extension on the same src move-assigns the
+      // cached map, so reads must stay inside the critical section.
+      std::lock_guard<std::mutex> lock(g->stripe_for(src).mu);
       const auto& dist = g->dists_from(src, bound);
       for (int32_t j = 0; j < K; ++j) {
         const int32_t eb = edge_ids[(t + 1) * K + j];

@@ -57,7 +57,12 @@ def _sharded_run():
                 "0", "off", "false"):
             _sharded_cache = (None, 1, 1)
             return _sharded_cache
-        n = len(jax.devices())
+        # local devices only: in a multi-host job the decode inputs are
+        # host-local numpy arrays, and a device_put onto a global mesh's
+        # non-addressable devices would throw — each process shards over
+        # its own chips; cross-host scale-out stays uuid-partitioned
+        # (parallel/multihost.py), exactly the reference's partition axis
+        n = len(jax.local_devices())
         if n <= 1:
             _sharded_cache = (None, 1, 1)
             return _sharded_cache
@@ -71,7 +76,7 @@ def _sharded_run():
         data = n // seq
         from ..parallel.mesh import make_mesh
         from ..parallel.sharded import sharded_viterbi
-        mesh = make_mesh((data, seq))
+        mesh = make_mesh((data, seq), devices=jax.local_devices())
         _sharded_cache = (sharded_viterbi(mesh), data, seq)
     return _sharded_cache
 

@@ -70,6 +70,23 @@ def test_assoc_matches_sequential(batch):
         assert s2 == pytest.approx(s1, abs=1e-2), f"trace {b}"
 
 
+def test_numpy_oracle_matches_device_decodes(batch):
+    """cpu_ref.viterbi_decode_numpy (the bench baseline / oracle) agrees
+    with the device decode on real prepared traces."""
+    from reporter_tpu.matcher.cpu_ref import viterbi_decode_numpy
+    sigma, beta = np.float32(4.07), np.float32(3.0)
+    p_dev, _ = viterbi_decode_batch(
+        batch.dist_m, batch.valid, batch.route_m, batch.gc_m, batch.case,
+        sigma, beta)
+    for b, trace in enumerate(batch.traces):
+        p_np, _ = viterbi_decode_numpy(
+            batch.dist_m[b], batch.valid[b], batch.route_m[b],
+            batch.gc_m[b], batch.case[b], sigma, beta)
+        s_dev = path_score_f64(batch, b, np.asarray(p_dev)[b])
+        s_np = path_score_f64(batch, b, p_np)
+        assert s_np == pytest.approx(s_dev, abs=1e-2), f"trace {b}"
+
+
 def test_restart_semantics_equivalent():
     # hand-built case with a restart in the middle and a skip tail
     from reporter_tpu.matcher.hmm import NORMAL, RESTART, SKIP
