@@ -35,6 +35,8 @@ struct Graph {
   std::vector<double> node_x, node_y;
   std::vector<int32_t> edge_start, edge_end;
   std::vector<float> edge_len;
+  std::vector<float> edge_speed;       // kph; for route travel time
+  std::vector<float> head_x, head_y;   // unit heading per edge; turn costs
 
   // CSR out-adjacency
   std::vector<int64_t> csr_off;
@@ -44,6 +46,12 @@ struct Graph {
   double cell = 250.0;
   std::unordered_map<int64_t, std::vector<int32_t>> cells;
 
+  // travel seconds along edge e for `meters` of it
+  float edge_secs(int32_t e, float meters) const {
+    const float v = std::max(edge_speed[e], 1.0f) * (1.0f / 3.6f);  // m/s
+    return meters / v;
+  }
+
   // per-source-node bounded dijkstra cache: node -> (bound, dists).
   // Lock-STRIPED: ctypes releases the GIL, so many Python threads
   // prepare traces through one handle concurrently; a whole-cache mutex
@@ -52,9 +60,17 @@ struct Graph {
   // racing on the same source node — where waiting is the right call
   // anyway (the winner's cache entry saves the loser the search).
   static constexpr int kStripes = 64;
+  // per-target (network distance m, travel time s) along the
+  // shortest-DISTANCE path — time rides along for the
+  // max_route_time_factor admissibility bound, it does not drive the
+  // search (matching Meili: the matcher routes by distance, then bounds
+  // the route's travel time against the probes' elapsed time)
+  struct DistTime {
+    float d, t;
+  };
   struct CacheStripe {
-    std::unordered_map<int32_t,
-                       std::pair<float, std::unordered_map<int32_t, float>>>
+    std::unordered_map<
+        int32_t, std::pair<float, std::unordered_map<int32_t, DistTime>>>
         map;
     std::mutex mu;
   };
@@ -72,6 +88,16 @@ struct Graph {
 
   void build(double cell_m) {
     cell = cell_m;
+    // unit headings (straight-segment geometry)
+    head_x.resize(n_edges);
+    head_y.resize(n_edges);
+    for (int64_t e = 0; e < n_edges; ++e) {
+      const double dx = node_x[edge_end[e]] - node_x[edge_start[e]];
+      const double dy = node_y[edge_end[e]] - node_y[edge_start[e]];
+      const double n = std::max(std::hypot(dx, dy), 1e-9);
+      head_x[e] = static_cast<float>(dx / n);
+      head_y[e] = static_cast<float>(dy / n);
+    }
     // CSR
     csr_off.assign(n_nodes + 1, 0);
     for (int64_t e = 0; e < n_edges; ++e) csr_off[edge_start[e] + 1]++;
@@ -98,31 +124,32 @@ struct Graph {
   // entries. Caller must hold stripe_for(src).mu for the whole call AND
   // for as long as it reads the returned map (an extension to a larger
   // bound move-assigns the mapped value, invalidating concurrent reads).
-  const std::unordered_map<int32_t, float>& dists_from(int32_t src,
-                                                       float bound) {
+  const std::unordered_map<int32_t, DistTime>& dists_from(int32_t src,
+                                                          float bound) {
     auto& route_cache = stripe_for(src).map;
     auto it = route_cache.find(src);
     if (it != route_cache.end() && it->second.first >= bound)
       return it->second.second;
-    std::unordered_map<int32_t, float> dist;
+    std::unordered_map<int32_t, DistTime> dist;
     using QE = std::pair<float, int32_t>;
     std::priority_queue<QE, std::vector<QE>, std::greater<QE>> heap;
-    dist[src] = 0.0f;
+    dist[src] = {0.0f, 0.0f};
     heap.push({0.0f, src});
     while (!heap.empty()) {
       auto [d, u] = heap.top();
       heap.pop();
       auto du = dist.find(u);
-      if (du != dist.end() && d > du->second) continue;
+      if (du != dist.end() && d > du->second.d) continue;
       if (d > bound) break;
+      const float tu = dist[u].t;
       for (int64_t k = csr_off[u]; k < csr_off[u + 1]; ++k) {
         int32_t e = csr_edge[k];
         int32_t v = edge_end[e];
         float nd = d + edge_len[e];
         if (nd > bound) continue;
         auto dv = dist.find(v);
-        if (dv == dist.end() || nd < dv->second) {
-          dist[v] = nd;
+        if (dv == dist.end() || nd < dv->second.d) {
+          dist[v] = {nd, tu + edge_secs(e, edge_len[e])};
           heap.push({nd, v});
         }
       }
@@ -141,7 +168,8 @@ extern "C" {
 void* rt_graph_create(int64_t n_nodes, int64_t n_edges,
                       const double* node_x, const double* node_y,
                       const int32_t* edge_start, const int32_t* edge_end,
-                      const float* edge_len, double cell_m) {
+                      const float* edge_len, const float* edge_speed_kph,
+                      double cell_m) {
   auto* g = new Graph();
   g->n_nodes = n_nodes;
   g->n_edges = n_edges;
@@ -150,6 +178,7 @@ void* rt_graph_create(int64_t n_nodes, int64_t n_edges,
   g->edge_start.assign(edge_start, edge_start + n_edges);
   g->edge_end.assign(edge_end, edge_end + n_edges);
   g->edge_len.assign(edge_len, edge_len + n_edges);
+  g->edge_speed.assign(edge_speed_kph, edge_speed_kph + n_edges);
   g->build(cell_m);
   return g;
 }
@@ -251,15 +280,31 @@ void rt_candidates(void* handle, int64_t n_points, const double* px,
 }
 
 // (T-1, K, K) route-distance tensor between consecutive candidate sets.
-// edge_ids/offsets are (T, K) row-major; gc is (T-1).
+// edge_ids/offsets are (T, K) row-major; gc is (T-1); dt is (T-1) probe
+// time deltas in seconds (may be null: no time bound).
+//
+// Admissibility mirrors Meili's two bounds (reference: Dockerfile:14-17):
+// distance — route fits within max(min_bound, factor * gc);
+// time     — the route's travel time at edge speeds fits within
+//            time_factor * dt (skipped when either is <= 0).
+// turn_penalty_factor adds meters for the heading change between the two
+// candidate edges: factor * 0.5 * (1 - cos(theta)) — 0 when straight,
+// `factor` for a full U-turn — the penalised route distance Meili feeds
+// its transition cost.
 void rt_route_matrices(void* handle, int64_t T, int32_t K,
                        const int32_t* edge_ids, const float* offsets,
-                       const float* gc, double factor, double min_bound,
-                       double backward_tol, float* out) {
+                       const float* gc, const double* dt, double factor,
+                       double min_bound, double backward_tol,
+                       double time_factor, double turn_penalty_factor,
+                       float* out) {
   auto* g = static_cast<Graph*>(handle);
   for (int64_t t = 0; t + 1 < T; ++t) {
     const float bound = static_cast<float>(
         std::max(min_bound, factor * static_cast<double>(gc[t])));
+    const float time_cap =
+        (dt != nullptr && time_factor > 0 && dt[t] > 0)
+            ? static_cast<float>(time_factor * dt[t])
+            : -1.0f;  // no bound
     for (int32_t i = 0; i < K; ++i) {
       const int32_t ea = edge_ids[t * K + i];
       float* row = out + (t * K + i) * K;
@@ -284,7 +329,9 @@ void rt_route_matrices(void* handle, int64_t T, int32_t K,
         }
         const float ob = offsets[(t + 1) * K + j];
         if (eb == ea && ob >= oa) {
-          row[j] = ob - oa;
+          row[j] = (time_cap >= 0 && g->edge_secs(ea, ob - oa) > time_cap)
+                       ? kUnreachable
+                       : ob - oa;
           continue;
         }
         // forgive small apparent backward movement on the same directed
@@ -301,9 +348,26 @@ void rt_route_matrices(void* handle, int64_t T, int32_t K,
         auto it = dist.find(g->edge_start[eb]);
         // reachable only if the whole route fits inside the bound, matching
         // the python fallback's max_dist semantics (graph/route.py)
-        row[j] = (it == dist.end() || via + it->second > bound)
-                     ? kUnreachable
-                     : via + it->second;
+        if (it == dist.end() || via + it->second.d > bound) {
+          row[j] = kUnreachable;
+          continue;
+        }
+        if (time_cap >= 0) {
+          const float secs = g->edge_secs(ea, remaining) +
+                             g->edge_secs(eb, ob) + it->second.t;
+          if (secs > time_cap) {
+            row[j] = kUnreachable;
+            continue;
+          }
+        }
+        float d = via + it->second.d;
+        if (turn_penalty_factor > 0) {
+          const float cos_th = g->head_x[ea] * g->head_x[eb] +
+                               g->head_y[ea] * g->head_y[eb];
+          d += static_cast<float>(turn_penalty_factor) * 0.5f *
+               (1.0f - cos_th);
+        }
+        row[j] = d;
       }
     }
   }
