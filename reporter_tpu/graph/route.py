@@ -24,26 +24,42 @@ from .spatial import CandidateSet, PAD_EDGE
 UNREACHABLE = np.float32(1.0e9)
 
 
+def _edge_secs(net: RoadNetwork, e: int, meters: float) -> float:
+    """Travel seconds for ``meters`` of edge ``e`` at its speed (floored at
+    1 kph, matching the native runtime's edge_secs)."""
+    v = max(float(net.edge_speed_kph[e]), 1.0) / 3.6
+    return meters / v
+
+
 def _dijkstra_bounded(net: RoadNetwork, source_node: int, max_dist: float,
-                      ) -> Dict[int, float]:
-    """Single-source shortest path lengths (meters) out to ``max_dist``."""
+                      ) -> Dict[int, tuple]:
+    """Single-source shortest paths out to ``max_dist``; each entry is
+    ``(distance_m, travel_time_s)`` along the shortest-DISTANCE path.
+
+    Time rides along for the max_route_time_factor admissibility bound —
+    it does not drive the search (matching Meili: routes by distance, then
+    bounds the route's travel time against the probes' elapsed time).
+    """
     offsets, edge_ids = net.csr()
     lengths = net.edge_length_m
     ends = net.edge_end
-    dist = {source_node: 0.0}
+    dist: Dict[int, tuple] = {source_node: (0.0, 0.0)}
     heap = [(0.0, source_node)]
     while heap:
         d, u = heapq.heappop(heap)
-        if d > dist.get(u, np.inf):
+        du = dist.get(u)
+        if du is not None and d > du[0]:
             continue
         if d > max_dist:
             break
+        tu = dist[u][1]
         for idx in range(offsets[u], offsets[u + 1]):
             e = edge_ids[idx]
             v = int(ends[e])
             nd = d + float(lengths[e])
-            if nd <= max_dist and nd < dist.get(v, np.inf):
-                dist[v] = nd
+            dv = dist.get(v)
+            if nd <= max_dist and (dv is None or nd < dv[0]):
+                dist[v] = (nd, tu + _edge_secs(net, e, float(lengths[e])))
                 heapq.heappush(heap, (nd, v))
     return dist
 
@@ -88,7 +104,8 @@ class RouteCache:
     """Caches bounded single-source Dijkstra results by (source node).
 
     A cached entry is only reused when its bound covers the requested bound;
-    otherwise it is recomputed at the larger bound.
+    otherwise it is recomputed at the larger bound. Entries map
+    ``node -> (distance_m, travel_time_s)``.
     """
 
     def __init__(self, net: RoadNetwork):
@@ -97,7 +114,7 @@ class RouteCache:
         self.hits = 0
         self.misses = 0
 
-    def distances_from(self, node: int, max_dist: float) -> Dict[int, float]:
+    def distances_from(self, node: int, max_dist: float) -> Dict[int, tuple]:
         entry = self._cache.get(node)
         if entry is not None and entry[0] >= max_dist:
             self.hits += 1
@@ -111,7 +128,9 @@ class RouteCache:
 def route_distance(net: RoadNetwork, edge_a: int, offset_a: float,
                    edge_b: int, offset_b: float, max_dist: float,
                    cache: Optional[RouteCache] = None,
-                   backward_tolerance_m: float = 0.0) -> float:
+                   backward_tolerance_m: float = 0.0,
+                   time_cap_s: float = -1.0,
+                   turn_penalty_m: float = 0.0) -> float:
     """Network distance from a point ``offset_a`` along ``edge_a`` to a point
     ``offset_b`` along ``edge_b``; UNREACHABLE beyond ``max_dist``.
 
@@ -121,8 +140,17 @@ def route_distance(net: RoadNetwork, edge_a: int, offset_a: float,
     the block, which makes a one-point flicker onto the co-located reverse
     edge the cheaper Viterbi path — exactly the segment-flapping the matcher
     must not emit.
+
+    ``time_cap_s`` >= 0 additionally requires the route's travel time at
+    edge speeds to fit the cap (Meili's ``max-route-time-factor`` bound);
+    ``turn_penalty_m`` is added to general routes after admissibility (the
+    caller prices the heading change between the two candidate edges).
+    Semantics mirror the native runtime's rt_route_matrices exactly.
     """
     if edge_a == edge_b and offset_b >= offset_a:
+        if time_cap_s >= 0 and _edge_secs(net, edge_a,
+                                          offset_b - offset_a) > time_cap_s:
+            return float(UNREACHABLE)
         return offset_b - offset_a
     if edge_a == edge_b and offset_a - offset_b <= backward_tolerance_m:
         return 0.0
@@ -133,14 +161,29 @@ def route_distance(net: RoadNetwork, edge_a: int, offset_a: float,
     src = int(net.edge_end[edge_a])
     dst = int(net.edge_start[edge_b])
     if cache is not None:
-        node_d = cache.distances_from(src, max_dist - via).get(dst)
+        node_dt = cache.distances_from(src, max_dist - via).get(dst)
     else:
-        node_d = _dijkstra_bounded(net, src, max_dist - via).get(dst)
+        node_dt = _dijkstra_bounded(net, src, max_dist - via).get(dst)
     # a reused cache entry may have been computed at a larger bound and
     # contain nodes beyond this query's cap — re-check the total
-    if node_d is None or via + node_d > max_dist:
+    if node_dt is None or via + node_dt[0] > max_dist:
         return float(UNREACHABLE)
-    return via + node_d
+    if time_cap_s >= 0:
+        secs = (_edge_secs(net, edge_a, remaining)
+                + _edge_secs(net, edge_b, offset_b) + node_dt[1])
+        if secs > time_cap_s:
+            return float(UNREACHABLE)
+    return via + node_dt[0] + turn_penalty_m
+
+
+def _edge_headings(net: RoadNetwork) -> np.ndarray:
+    """(E, 2) unit heading per edge in projected meters (straight-segment
+    geometry, matching the native runtime's head_x/head_y)."""
+    nx, ny = net.node_xy()
+    dx = nx[net.edge_end] - nx[net.edge_start]
+    dy = ny[net.edge_end] - ny[net.edge_start]
+    n = np.maximum(np.hypot(dx, dy), 1e-9)
+    return np.stack([dx / n, dy / n], axis=1)
 
 
 def candidate_route_matrices(net: RoadNetwork, cands: CandidateSet,
@@ -148,20 +191,39 @@ def candidate_route_matrices(net: RoadNetwork, cands: CandidateSet,
                              max_route_distance_factor: float = 5.0,
                              min_bound_m: float = 500.0,
                              cache: Optional[RouteCache] = None,
-                             backward_tolerance_m: float = 0.0) -> np.ndarray:
+                             backward_tolerance_m: float = 0.0,
+                             dt: Optional[np.ndarray] = None,
+                             max_route_time_factor: float = 0.0,
+                             min_time_bound_s: float = 60.0,
+                             turn_penalty_factor: float = 0.0) -> np.ndarray:
     """(T-1, K, K) route-distance tensor between consecutive candidates.
 
     ``gc_dist`` is the (T-1,) great-circle distance between consecutive
     probes; the search bound per step is
     ``max(min_bound_m, factor * gc_dist)`` mirroring the reference's
     ``max-route-distance-factor`` cap (reference: Dockerfile:14-17).
+
+    ``dt`` (T-1,) probe time deltas + ``max_route_time_factor`` > 0 enable
+    Meili's time-admissibility bound: a transition whose travel time at
+    edge speeds exceeds ``max(min_time_bound_s, factor * dt[t])`` is
+    unreachable (the floor parallels ``min_bound_m`` on the distance side —
+    at 1 Hz sampling factor*dt is ~2 s, which GPS noise alone overruns).
+    ``turn_penalty_factor`` adds ``factor * 0.5 * (1 - cos(theta))`` meters
+    for the heading change between the two candidate edges (0 straight,
+    ``factor`` for a U-turn) — the penalised route distance Meili feeds its
+    transition cost. Mirrors the native rt_route_matrices exactly.
     """
     T, K = cands.edge_ids.shape
     if cache is None:
         cache = RouteCache(net)
+    heads = _edge_headings(net) if turn_penalty_factor > 0 else None
     out = np.full((max(T - 1, 0), K, K), UNREACHABLE, dtype=np.float32)
     for t in range(T - 1):
         bound = max(min_bound_m, max_route_distance_factor * float(gc_dist[t]))
+        time_cap = -1.0
+        if dt is not None and max_route_time_factor > 0 and float(dt[t]) > 0:
+            time_cap = max(min_time_bound_s,
+                           max_route_time_factor * float(dt[t]))
         for i in range(K):
             ea = int(cands.edge_ids[t, i])
             if ea == PAD_EDGE:
@@ -172,7 +234,12 @@ def candidate_route_matrices(net: RoadNetwork, cands: CandidateSet,
                 if eb == PAD_EDGE:
                     continue
                 ob = float(cands.offset_m[t + 1, j])
+                penalty = 0.0
+                if heads is not None:
+                    cos_th = float(heads[ea] @ heads[eb])
+                    penalty = turn_penalty_factor * 0.5 * (1.0 - cos_th)
                 out[t, i, j] = route_distance(
                     net, ea, oa, eb, ob, bound, cache,
-                    backward_tolerance_m=backward_tolerance_m)
+                    backward_tolerance_m=backward_tolerance_m,
+                    time_cap_s=time_cap, turn_penalty_m=penalty)
     return out

@@ -131,17 +131,30 @@ def prepare_trace(net: RoadNetwork, grid: SpatialGrid | None,
                            lat[kept[1:]], lon[kept[1:]]) if n > 1 else np.zeros(0)
     gc = np.atleast_1d(np.asarray(gc, dtype=np.float32))
 
+    # probe time deltas between consecutive KEPT points feed Meili's
+    # max_route_time_factor admissibility bound (reference: Dockerfile:16);
+    # None disables the bound entirely (factor <= 0)
+    dt = None
+    if params.max_route_time_factor > 0 and n > 1:
+        dt = np.diff(times[kept])
+
     if runtime is not None:
         route = runtime.route_matrices(
             cands, gc,
             max_route_distance_factor=params.max_route_distance_factor,
-            backward_tolerance_m=params.backward_tolerance_m)
+            backward_tolerance_m=params.backward_tolerance_m,
+            dt=dt, max_route_time_factor=params.max_route_time_factor,
+            min_time_bound_s=params.min_time_bound_s,
+            turn_penalty_factor=params.turn_penalty_factor)
     else:
         route = candidate_route_matrices(
             net, cands, gc,
             max_route_distance_factor=params.max_route_distance_factor,
             cache=cache,
-            backward_tolerance_m=params.backward_tolerance_m)
+            backward_tolerance_m=params.backward_tolerance_m,
+            dt=dt, max_route_time_factor=params.max_route_time_factor,
+            min_time_bound_s=params.min_time_bound_s,
+            turn_penalty_factor=params.turn_penalty_factor)
 
     # case codes over kept points: RESTART at the first point and after
     # breakage-sized gaps; SKIP only in the padding tail

@@ -16,6 +16,11 @@ class MatchParams:
     beta: float = 3.0                  # transition exponential scale
     max_route_distance_factor: float = 5.0
     max_route_time_factor: float = 2.0
+    # floor on the time-admissibility cap max(floor, factor*dt), the time
+    # analog of the 500 m floor on the distance bound: at 1 Hz sampling
+    # factor*dt is ~2 s, which GPS projection noise alone overruns, so an
+    # unfloored bound prunes honest transitions instead of absurd detours
+    min_time_bound_s: float = 60.0
     breakage_distance: float = 2000.0  # meters; larger probe gaps split the HMM
     search_radius: float = 50.0        # meters candidate search radius
     turn_penalty_factor: float = 0.0

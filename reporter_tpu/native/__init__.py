@@ -27,6 +27,10 @@ logger = logging.getLogger("reporter_tpu.native")
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _LIB_PATH = os.path.join(_DIR, "libreporter_host.so")
+# Must equal host_runtime.cpp's rt_abi_version(). The handshake in
+# _get_lib() turns a half-landed ABI change (library and binding updated
+# in different commits) into a loud numpy fallback instead of a segfault.
+ABI_VERSION = 3
 _lib = None
 _build_lock = threading.Lock()
 _build_failed = False
@@ -62,10 +66,28 @@ def _try_build() -> Optional[ctypes.CDLL]:
 
 
 def _get_lib() -> Optional[ctypes.CDLL]:
-    global _lib
+    global _lib, _build_failed
     if _lib is None:
         lib = _try_build()
         if lib is None:
+            return None
+        # ABI handshake before any signature is trusted: a library built
+        # from a different revision of host_runtime.cpp must not be called
+        # through these argtypes (ctypes would happily pass the wrong
+        # argument list and segfault — that is exactly what took down
+        # round 2's snapshot).
+        try:
+            lib.rt_abi_version.restype = ctypes.c_int32
+            lib.rt_abi_version.argtypes = []
+            got = int(lib.rt_abi_version())
+        except AttributeError:
+            got = -1
+        if got != ABI_VERSION:
+            _build_failed = True
+            logger.error(
+                "native host runtime ABI mismatch (library=%d, binding=%d);"
+                " falling back to numpy — rebuild with `make -C %s clean"
+                " && make -C %s`", got, ABI_VERSION, _DIR, _DIR)
             return None
         c_i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
         c_f32p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
@@ -73,7 +95,7 @@ def _get_lib() -> Optional[ctypes.CDLL]:
         lib.rt_graph_create.restype = ctypes.c_void_p
         lib.rt_graph_create.argtypes = [
             ctypes.c_int64, ctypes.c_int64, c_f64p, c_f64p, c_i32p, c_i32p,
-            c_f32p, ctypes.c_double]
+            c_f32p, c_f32p, ctypes.c_double]
         lib.rt_graph_destroy.argtypes = [ctypes.c_void_p]
         lib.rt_cache_clear.argtypes = [ctypes.c_void_p]
         lib.rt_cache_size.argtypes = [ctypes.c_void_p]
@@ -81,9 +103,13 @@ def _get_lib() -> Optional[ctypes.CDLL]:
         lib.rt_candidates.argtypes = [
             ctypes.c_void_p, ctypes.c_int64, c_f64p, c_f64p, ctypes.c_int32,
             ctypes.c_double, c_i32p, c_f32p, c_f32p, c_f32p, c_f32p]
+        # dt is nullable (no time bound), so it binds as a raw pointer
+        # rather than an ndpointer
         lib.rt_route_matrices.argtypes = [
             ctypes.c_void_p, ctypes.c_int64, ctypes.c_int32, c_i32p, c_f32p,
-            c_f32p, ctypes.c_double, ctypes.c_double, ctypes.c_double, c_f32p]
+            c_f32p, ctypes.POINTER(ctypes.c_double), ctypes.c_double,
+            ctypes.c_double, ctypes.c_double, ctypes.c_double,
+            ctypes.c_double, ctypes.c_double, c_f32p]
         c_i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
         c_u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
         i64ref = ctypes.POINTER(ctypes.c_int64)
@@ -167,6 +193,7 @@ class NativeRuntime:
             np.ascontiguousarray(net.edge_start, dtype=np.int32),
             np.ascontiguousarray(net.edge_end, dtype=np.int32),
             np.ascontiguousarray(net.edge_length_m, dtype=np.float32),
+            np.ascontiguousarray(net.edge_speed_kph, dtype=np.float32),
             float(cell_m))
 
     def __del__(self):
@@ -201,7 +228,22 @@ class NativeRuntime:
     def route_matrices(self, cands, gc_dist,
                        max_route_distance_factor: float = 5.0,
                        min_bound_m: float = 500.0,
-                       backward_tolerance_m: float = 0.0) -> np.ndarray:
+                       backward_tolerance_m: float = 0.0,
+                       dt=None,
+                       max_route_time_factor: float = 0.0,
+                       min_time_bound_s: float = 60.0,
+                       turn_penalty_factor: float = 0.0) -> np.ndarray:
+        """(T-1, K, K) route distances; Meili's admissibility bounds.
+
+        ``dt`` is the (T-1,) probe time deltas in seconds; together with
+        ``max_route_time_factor`` > 0 it prunes transitions whose travel
+        time at edge speeds exceeds max(min_time_bound_s, factor*dt)
+        (reference knob ``max-route-time-factor``, Dockerfile:14-17; the
+        floor parallels min_bound_m on the distance side).
+        ``turn_penalty_factor`` adds meters scaled by the heading change
+        between candidate edges. Semantics mirror
+        graph.route.candidate_route_matrices exactly.
+        """
         T, K = cands.edge_ids.shape
         out = np.empty((max(T - 1, 0), K, K), dtype=np.float32)
         if T < 2:
@@ -209,10 +251,18 @@ class NativeRuntime:
         edge = np.ascontiguousarray(cands.edge_ids, dtype=np.int32)
         off = np.ascontiguousarray(cands.offset_m, dtype=np.float32)
         gc = np.ascontiguousarray(gc_dist, dtype=np.float32)
+        if dt is not None:
+            dt_arr = np.ascontiguousarray(dt, dtype=np.float64)
+            if dt_arr.shape != (T - 1,):
+                raise ValueError(f"dt must be (T-1,)={T-1}, got {dt_arr.shape}")
+            dt_ptr = dt_arr.ctypes.data_as(ctypes.POINTER(ctypes.c_double))
+        else:
+            dt_ptr = None
         self._lib.rt_route_matrices(
-            self._handle, T, K, edge, off, gc,
+            self._handle, T, K, edge, off, gc, dt_ptr,
             float(max_route_distance_factor), float(min_bound_m),
-            float(backward_tolerance_m), out)
+            float(backward_tolerance_m), float(max_route_time_factor),
+            float(min_time_bound_s), float(turn_penalty_factor), out)
         return out
 
     def cache_clear(self):

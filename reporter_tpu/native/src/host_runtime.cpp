@@ -165,6 +165,13 @@ struct Graph {
 
 extern "C" {
 
+// ABI handshake: the ctypes loader (native/__init__.py) refuses to use a
+// library whose version differs from its expectation, falling back to the
+// numpy path loudly instead of calling through a stale signature. BUMP
+// THIS on ANY change to the signatures below, in the same commit as the
+// Python-side constant.
+int32_t rt_abi_version(void) { return 3; }
+
 void* rt_graph_create(int64_t n_nodes, int64_t n_edges,
                       const double* node_x, const double* node_y,
                       const int32_t* edge_start, const int32_t* edge_end,
@@ -295,15 +302,19 @@ void rt_route_matrices(void* handle, int64_t T, int32_t K,
                        const int32_t* edge_ids, const float* offsets,
                        const float* gc, const double* dt, double factor,
                        double min_bound, double backward_tol,
-                       double time_factor, double turn_penalty_factor,
-                       float* out) {
+                       double time_factor, double min_time_bound,
+                       double turn_penalty_factor, float* out) {
   auto* g = static_cast<Graph*>(handle);
   for (int64_t t = 0; t + 1 < T; ++t) {
     const float bound = static_cast<float>(
         std::max(min_bound, factor * static_cast<double>(gc[t])));
+    // min_time_bound floors the cap the way min_bound floors the distance
+    // bound: at 1 Hz sampling factor*dt is ~2 s, which GPS noise alone
+    // overruns — without the floor the time bound prunes honest
+    // transitions instead of absurd detours.
     const float time_cap =
         (dt != nullptr && time_factor > 0 && dt[t] > 0)
-            ? static_cast<float>(time_factor * dt[t])
+            ? static_cast<float>(std::max(min_time_bound, time_factor * dt[t]))
             : -1.0f;  // no bound
     for (int32_t i = 0; i < K; ++i) {
       const int32_t ea = edge_ids[t * K + i];
