@@ -68,6 +68,7 @@ class RoadNetwork:
     _node_x: Optional[np.ndarray] = None        # projected meters
     _node_y: Optional[np.ndarray] = None
     _proj: Optional[tuple] = None               # (to_xy, to_ll)
+    _anchor: Optional[tuple] = None             # (lat0, lon0)
 
     @property
     def num_nodes(self) -> int:
@@ -78,13 +79,20 @@ class RoadNetwork:
         return len(self.edge_start)
 
     # ---- projection ------------------------------------------------------
+    def projection_anchor(self):
+        """(lat0, lon0) the local projection is anchored at — the network
+        centroid. Exposed so the native batched prep can project points
+        with the identical chart (native/__init__.py prepare_batch)."""
+        if self._anchor is None:
+            self._anchor = (float(np.mean(self.node_lat)),
+                            float(np.mean(self.node_lon)))
+        return self._anchor
+
     def projection(self):
         """Local equirectangular meters projection anchored at the network
         centroid; built once and shared by spatial index and matcher."""
         if self._proj is None:
-            lat0 = float(np.mean(self.node_lat))
-            lon0 = float(np.mean(self.node_lon))
-            self._proj = local_meters_projection(lat0, lon0)
+            self._proj = local_meters_projection(*self.projection_anchor())
         return self._proj
 
     def node_xy(self):

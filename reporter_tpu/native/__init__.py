@@ -30,7 +30,7 @@ _LIB_PATH = os.path.join(_DIR, "libreporter_host.so")
 # Must equal host_runtime.cpp's rt_abi_version(). The handshake in
 # _get_lib() turns a half-landed ABI change (library and binding updated
 # in different commits) into a loud numpy fallback instead of a segfault.
-ABI_VERSION = 3
+ABI_VERSION = 4
 _lib = None
 _build_lock = threading.Lock()
 _build_failed = False
@@ -111,7 +111,27 @@ def _get_lib() -> Optional[ctypes.CDLL]:
             ctypes.c_double, ctypes.c_double, ctypes.c_double,
             ctypes.c_double, ctypes.c_double, c_f32p]
         c_i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+        c_u16p = np.ctypeslib.ndpointer(np.uint16, flags="C_CONTIGUOUS")
         c_u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+        lib.rt_f32_to_f16.argtypes = [c_f32p, c_u16p, ctypes.c_int64]
+        lib.rt_assemble_batch.restype = ctypes.c_int64
+        lib.rt_assemble_batch.argtypes = [
+            ctypes.c_int64, ctypes.c_int32, ctypes.c_int32,
+            c_i32p, c_i32p, c_f32p, c_f32p, c_i32p, c_i32p, c_i32p, c_f32p,
+            c_i64p, c_f64p,
+            c_i64p, c_f32p, c_u8p, c_i64p, c_f64p, ctypes.c_int64,
+            ctypes.c_double, ctypes.c_double, ctypes.c_int64,
+            c_i64p, c_i64p, c_u8p, c_f64p, c_f64p, c_i32p, c_i32p,
+            c_i32p, c_i32p, c_i64p, c_i64p]
+        lib.rt_prepare_batch.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, c_i64p, c_f64p, c_f64p, c_f64p,
+            ctypes.c_double, ctypes.c_double, ctypes.c_int32, ctypes.c_int32,
+            ctypes.c_double, ctypes.c_double, ctypes.c_double,
+            ctypes.c_double, ctypes.c_double, ctypes.c_double,
+            ctypes.c_double, ctypes.c_double, ctypes.c_double,
+            ctypes.c_int32,
+            c_i32p, c_f32p, c_f32p, c_f32p, c_f32p, c_i32p, c_i32p, c_i32p,
+            c_f32p]
         i64ref = ctypes.POINTER(ctypes.c_int64)
         lib.rt_tile_counts.restype = ctypes.c_int32
         lib.rt_tile_counts.argtypes = [
@@ -263,6 +283,154 @@ class NativeRuntime:
             float(max_route_distance_factor), float(min_bound_m),
             float(backward_tolerance_m), float(max_route_time_factor),
             float(min_time_bound_s), float(turn_penalty_factor), out)
+        return out
+
+    # -- whole-batch prep (the hot path) -----------------------------------
+    def prepare_batch(self, pt_off, lat, lon, times, T: int, K: int,
+                      search_radius: float, interpolation_distance: float,
+                      breakage_distance: float,
+                      max_route_distance_factor: float = 5.0,
+                      min_bound_m: float = 500.0,
+                      backward_tolerance_m: float = 0.0,
+                      max_route_time_factor: float = 0.0,
+                      min_time_bound_s: float = 60.0,
+                      turn_penalty_factor: float = 0.0,
+                      n_threads: int = 0, n_rows: int | None = None):
+        """Prepare B traces in ONE native call, straight into padded
+        (rows, T, ...) batch tensors — candidates, jitter filtering, case
+        codes and route matrices per matcher/batchpad.py prepare_trace
+        semantics, fanned out across C++ threads (no GIL, no per-trace
+        Python). ``pt_off`` is (B+1,) int64 offsets into the flat
+        lat/lon/times point arrays; ``n_rows`` >= B allocates extra
+        all-SKIP filler rows (mesh/pow2 batch padding).
+
+        Returns a dict of the filled tensors: edge_ids (rows,T,K) i32,
+        dist_m/offset_m (rows,T,K) f32, route_m (rows,T-1,K,K) f32,
+        gc_m (rows,T-1) f32, case (rows,T) i32, kept_idx (rows,T) i32
+        (-1 pad), num_kept (rows,) i32, dwell (rows,) f32.
+        """
+        pt_off = np.ascontiguousarray(pt_off, dtype=np.int64)
+        lat = np.ascontiguousarray(lat, dtype=np.float64)
+        lon = np.ascontiguousarray(lon, dtype=np.float64)
+        times = np.ascontiguousarray(times, dtype=np.float64)
+        B = len(pt_off) - 1
+        rows = n_rows if n_rows is not None else B
+        if rows < B:
+            raise ValueError(f"n_rows={rows} < B={B}")
+        from ..graph.spatial import PAD_DIST, PAD_EDGE
+        from ..graph.route import UNREACHABLE
+        from ..matcher.hmm import SKIP
+        Tm1 = max(T - 1, 0)
+        out = {
+            "edge_ids": np.full((rows, T, K), PAD_EDGE, np.int32),
+            "dist_m": np.full((rows, T, K), PAD_DIST, np.float32),
+            "offset_m": np.zeros((rows, T, K), np.float32),
+            "route_m": np.full((rows, Tm1, K, K), UNREACHABLE, np.float32),
+            "gc_m": np.zeros((rows, Tm1), np.float32),
+            "case": np.full((rows, T), SKIP, np.int32),
+            "kept_idx": np.full((rows, T), -1, np.int32),
+            "num_kept": np.zeros(rows, np.int32),
+            "dwell": np.zeros(rows, np.float32),
+        }
+        lat0, lon0 = self.net.projection_anchor()
+        self._lib.rt_prepare_batch(
+            self._handle, B, pt_off, lat, lon, times,
+            float(lat0), float(lon0), T, K,
+            float(search_radius), float(interpolation_distance),
+            float(breakage_distance), float(max_route_distance_factor),
+            float(min_bound_m), float(backward_tolerance_m),
+            float(max_route_time_factor), float(min_time_bound_s),
+            float(turn_penalty_factor), int(n_threads),
+            out["edge_ids"], out["dist_m"], out["offset_m"],
+            out["route_m"], out["gc_m"], out["case"], out["kept_idx"],
+            out["num_kept"], out["dwell"])
+        return out
+
+    def to_f16(self, arr: np.ndarray) -> np.ndarray:
+        """f32 -> f16 wire cast via F16C (bit-identical to numpy astype;
+        round-to-nearest-even, overflow to inf). The numpy cast was the
+        largest single host cost after batching (round-4 profile)."""
+        src = np.ascontiguousarray(arr, dtype=np.float32)
+        out = np.empty(src.shape, dtype=np.float16)
+        self._lib.rt_f32_to_f16(src.reshape(-1), out.view(np.uint16).reshape(-1),
+                                src.size)
+        return out
+
+    def _assembly_columns(self):
+        """Graph columns the native assembler needs, staged contiguous once
+        per runtime (sorted segment-length table for the C++ binary
+        search)."""
+        cols = getattr(self, "_asm_cols", None)
+        if cols is None:
+            net = self.net
+            seg_ids = np.array(sorted(net.segment_length_m), dtype=np.int64)
+            seg_lens = np.array(
+                [net.segment_length_m[int(s)] for s in seg_ids],
+                dtype=np.float64)
+            cols = {
+                "edge_seg_id": np.ascontiguousarray(
+                    net.edge_segment_id, dtype=np.int64),
+                "edge_seg_off": np.ascontiguousarray(
+                    net.edge_segment_offset_m, dtype=np.float32),
+                "edge_internal": np.ascontiguousarray(
+                    net.edge_internal, dtype=np.uint8),
+                "seg_ids": seg_ids,
+                "seg_lens": seg_lens,
+            }
+            self._asm_cols = cols
+        return cols
+
+    def assemble_batch(self, path, prep: dict, pt_off, times,
+                       queue_threshold_kph: float,
+                       interpolation_distance_m: float):
+        """Walk B decoded paths into segment runs in ONE native call.
+
+        ``path`` (B, T) decoded candidate indices (live rows only);
+        ``prep`` the dict from :meth:`prepare_batch`. Returns the flat run
+        columns: (run_off, seg_id, internal, start, end, length, queue,
+        begin_idx, end_idx, way_off, ways) — Python formats these into the
+        reference-schema segment dicts (matcher/assemble.py semantics,
+        pinned by parity tests).
+        """
+        cols = self._assembly_columns()
+        path = np.ascontiguousarray(path, dtype=np.int32)
+        B, T = path.shape
+        K = prep["edge_ids"].shape[2]
+        num_kept = prep["num_kept"][:B]
+        cap = max(int(num_kept.sum()), 1)
+        run_off = np.empty(B + 1, dtype=np.int64)
+        out = {
+            "seg_id": np.empty(cap, np.int64),
+            "internal": np.empty(cap, np.uint8),
+            "start": np.empty(cap, np.float64),
+            "end": np.empty(cap, np.float64),
+            "length": np.empty(cap, np.int32),
+            "queue": np.empty(cap, np.int32),
+            "begin_idx": np.empty(cap, np.int32),
+            "end_idx": np.empty(cap, np.int32),
+            "way_off": np.empty(cap + 1, np.int64),
+            "ways": np.empty(cap, np.int64),
+        }
+        n = self._lib.rt_assemble_batch(
+            B, T, K, path,
+            prep["edge_ids"][:B], prep["offset_m"][:B],
+            prep["route_m"][:B], prep["case"][:B], prep["kept_idx"][:B],
+            np.ascontiguousarray(num_kept, dtype=np.int32),
+            prep["dwell"][:B],
+            np.ascontiguousarray(pt_off, dtype=np.int64),
+            np.ascontiguousarray(times, dtype=np.float64),
+            cols["edge_seg_id"], cols["edge_seg_off"],
+            cols["edge_internal"], cols["seg_ids"], cols["seg_lens"],
+            len(cols["seg_ids"]),
+            float(queue_threshold_kph), float(interpolation_distance_m),
+            cap, run_off, out["seg_id"], out["internal"], out["start"],
+            out["end"], out["length"], out["queue"], out["begin_idx"],
+            out["end_idx"], out["way_off"], out["ways"])
+        if n < 0:
+            raise RuntimeError("rt_assemble_batch capacity overflow "
+                               f"(cap={cap}) — capacity invariant broken")
+        out["run_off"] = run_off
+        out["n_runs"] = int(n)
         return out
 
     def cache_clear(self):

@@ -15,13 +15,20 @@
 
 #include <algorithm>
 #include <array>
+#include <atomic>
+#include <cfenv>
 #include <cmath>
 #include <cstdint>
 #include <cstring>
 #include <mutex>
 #include <queue>
+#include <thread>
 #include <unordered_map>
 #include <vector>
+
+#ifdef __F16C__
+#include <immintrin.h>
+#endif
 
 namespace {
 
@@ -161,6 +168,202 @@ struct Graph {
   }
 };
 
+// ---- shared per-point / per-step helpers --------------------------------
+// The single-call APIs (rt_candidates, rt_route_matrices) and the batched
+// rt_prepare_batch funnel through these so semantics cannot drift.
+
+struct Cand {
+  double d;  // double so tie-ordering matches the numpy float64 sort
+  int32_t e;
+  float off, qx, qy;
+};
+
+// per-thread scratch for candidate search (seen is n_edges bytes; reused
+// across points so the clear is O(|touched|), not O(E))
+struct CandScratch {
+  std::vector<Cand> cands;
+  std::vector<char> seen;
+  std::vector<int32_t> seen_list;
+  explicit CandScratch(int64_t n_edges) : seen(n_edges, 0) {}
+};
+
+// K nearest edges within radius of projected point (x, y); writes one
+// (K,) row of each output, padded with kPadEdge / kPadDist / 0.
+void candidates_for_point(const Graph* g, double x, double y, int32_t k,
+                          double radius, CandScratch& s, int32_t* out_edge,
+                          float* out_dist, float* out_off, float* out_px,
+                          float* out_py) {
+  const double cell = g->cell;
+  const int64_t reach = static_cast<int64_t>(std::ceil(radius / cell));
+  s.cands.clear();
+  for (int32_t e : s.seen_list) s.seen[e] = 0;
+  s.seen_list.clear();
+  const int64_t ci = static_cast<int64_t>(std::floor(x / cell));
+  const int64_t cj = static_cast<int64_t>(std::floor(y / cell));
+  for (int64_t i = ci - reach; i <= ci + reach; ++i) {
+    for (int64_t j = cj - reach; j <= cj + reach; ++j) {
+      auto it = g->cells.find(Graph::cell_key(i, j));
+      if (it == g->cells.end()) continue;
+      for (int32_t e : it->second) {
+        if (s.seen[e]) continue;
+        s.seen[e] = 1;
+        s.seen_list.push_back(e);
+        const double ax = g->node_x[g->edge_start[e]];
+        const double ay = g->node_y[g->edge_start[e]];
+        const double bx = g->node_x[g->edge_end[e]];
+        const double by = g->node_y[g->edge_end[e]];
+        const double dx = bx - ax, dy = by - ay;
+        const double len2 = std::max(dx * dx + dy * dy, 1e-9);
+        double f = ((x - ax) * dx + (y - ay) * dy) / len2;
+        f = std::min(1.0, std::max(0.0, f));
+        const double qx = ax + f * dx, qy = ay + f * dy;
+        // cheap squared-distance prefilter (with ulp slack) so the exact
+        // but slow hypot — which must match numpy's np.hypot for
+        // tie-order parity (graph/spatial.py:125) — only runs for edges
+        // actually near the point
+        const double ex = x - qx, ey = y - qy;
+        if (ex * ex + ey * ey > radius * radius * 1.0000001) continue;
+        const double d = std::hypot(ex, ey);
+        if (d <= radius) {
+          s.cands.push_back({d, e, static_cast<float>(f * g->edge_len[e]),
+                             static_cast<float>(qx), static_cast<float>(qy)});
+        }
+      }
+    }
+  }
+  const int32_t n = static_cast<int32_t>(
+      std::min<size_t>(s.cands.size(), static_cast<size_t>(k)));
+  // top-K by distance, ties by edge id (matches numpy stable sort over
+  // edge-id-ordered input; plain sort is safe — (d, e) pairs are unique
+  // since each edge appears once — and does not allocate)
+  std::sort(s.cands.begin(), s.cands.end(),
+            [](const Cand& a, const Cand& b) {
+              return a.d < b.d || (a.d == b.d && a.e < b.e);
+            });
+  for (int32_t q = 0; q < k; ++q) {
+    if (q < n) {
+      out_edge[q] = s.cands[q].e;
+      out_dist[q] = static_cast<float>(s.cands[q].d);
+      out_off[q] = s.cands[q].off;
+      if (out_px) out_px[q] = s.cands[q].qx;
+      if (out_py) out_py[q] = s.cands[q].qy;
+    } else {
+      out_edge[q] = kPadEdge;
+      out_dist[q] = kPadDist;
+      out_off[q] = 0.0f;
+      if (out_px) out_px[q] = 0.0f;
+      if (out_py) out_py[q] = 0.0f;
+    }
+  }
+}
+
+// One (K, K) route-distance block between consecutive candidate rows.
+// Admissibility mirrors Meili's two bounds (reference: Dockerfile:14-17):
+// distance — route fits within max(min_bound, factor * gc);
+// time     — the route's travel time at edge speeds fits within
+//            max(min_time_bound, time_factor * dt) (skipped unless
+//            have_dt && time_factor > 0 && dt > 0).
+// turn_penalty_factor adds meters for the heading change between the two
+// candidate edges: factor * 0.5 * (1 - cos(theta)).
+void route_step(Graph* g, const int32_t* ea_row, const float* oa_row,
+                const int32_t* eb_row, const float* ob_row, int32_t K,
+                float gc_t, double dt_t, bool have_dt, double factor,
+                double min_bound, double backward_tol, double time_factor,
+                double min_time_bound, double turn_penalty_factor,
+                float* out) {
+  const float bound = static_cast<float>(
+      std::max(min_bound, factor * static_cast<double>(gc_t)));
+  // min_time_bound floors the cap the way min_bound floors the distance
+  // bound: at 1 Hz sampling factor*dt is ~2 s, which GPS noise alone
+  // overruns — without the floor the time bound prunes honest
+  // transitions instead of absurd detours.
+  const float time_cap =
+      (have_dt && time_factor > 0 && dt_t > 0)
+          ? static_cast<float>(std::max(min_time_bound, time_factor * dt_t))
+          : -1.0f;  // no bound
+  for (int32_t i = 0; i < K; ++i) {
+    const int32_t ea = ea_row[i];
+    float* row = out + static_cast<int64_t>(i) * K;
+    if (ea == kPadEdge) {
+      for (int32_t j = 0; j < K; ++j) row[j] = kUnreachable;
+      continue;
+    }
+    const float oa = oa_row[i];
+    const float remaining = g->edge_len[ea] - oa;
+    const int32_t src = g->edge_end[ea];
+    // one bounded search from ea's end node covers every target j.
+    // The stripe lock is held across compute AND the row fill below:
+    // a concurrent bound-extension on the same src move-assigns the
+    // cached map, so reads must stay inside the critical section.
+    std::lock_guard<std::mutex> lock(g->stripe_for(src).mu);
+    const auto& dist = g->dists_from(src, bound);
+    for (int32_t j = 0; j < K; ++j) {
+      const int32_t eb = eb_row[j];
+      if (eb == kPadEdge) {
+        row[j] = kUnreachable;
+        continue;
+      }
+      const float ob = ob_row[j];
+      if (eb == ea && ob >= oa) {
+        row[j] = (time_cap >= 0 && g->edge_secs(ea, ob - oa) > time_cap)
+                     ? kUnreachable
+                     : ob - oa;
+        continue;
+      }
+      // forgive small apparent backward movement on the same directed
+      // edge (along-track GPS noise) — see graph/route.py route_distance
+      if (eb == ea && oa - ob <= backward_tol) {
+        row[j] = 0.0f;
+        continue;
+      }
+      const float via = remaining + ob;
+      if (via > bound) {
+        row[j] = kUnreachable;
+        continue;
+      }
+      auto it = dist.find(g->edge_start[eb]);
+      // reachable only if the whole route fits inside the bound, matching
+      // the python fallback's max_dist semantics (graph/route.py)
+      if (it == dist.end() || via + it->second.d > bound) {
+        row[j] = kUnreachable;
+        continue;
+      }
+      if (time_cap >= 0) {
+        const float secs = g->edge_secs(ea, remaining) +
+                           g->edge_secs(eb, ob) + it->second.t;
+        if (secs > time_cap) {
+          row[j] = kUnreachable;
+          continue;
+        }
+      }
+      float d = via + it->second.d;
+      if (turn_penalty_factor > 0) {
+        const float cos_th =
+            g->head_x[ea] * g->head_x[eb] + g->head_y[ea] * g->head_y[eb];
+        d += static_cast<float>(turn_penalty_factor) * 0.5f * (1.0f - cos_th);
+      }
+      row[j] = d;
+    }
+  }
+}
+
+// equirectangular distance in meters, matching core/geo.py exactly
+// (double math; per-pair midpoint cosine — NOT the projection's fixed
+// anchor cosine, so kept-selection parity with the numpy path holds)
+constexpr double kMetersPerDeg = 20037581.187 / 180.0;
+constexpr double kRadPerDeg = 3.14159265358979323846 / 180.0;
+
+double equirect_m(double lat_a, double lon_a, double lat_b, double lon_b) {
+  const double x =
+      (lon_a - lon_b) * kMetersPerDeg * std::cos(0.5 * (lat_a + lat_b) *
+                                                 kRadPerDeg);
+  const double y = (lat_a - lat_b) * kMetersPerDeg;
+  // sqrt(x*x + y*y), NOT hypot: geo.py computes np.sqrt(x*x + y*y), and
+  // this value feeds strict threshold compares (interpolation_distance,
+  // breakage_distance) where a last-ulp divergence flips a decision
+  return std::sqrt(x * x + y * y);
+}
+
 }  // namespace
 
 extern "C" {
@@ -170,7 +373,7 @@ extern "C" {
 // numpy path loudly instead of calling through a stale signature. BUMP
 // THIS on ANY change to the signatures below, in the same commit as the
 // Python-side constant.
-int32_t rt_abi_version(void) { return 3; }
+int32_t rt_abi_version(void) { return 4; }
 
 void* rt_graph_create(int64_t n_nodes, int64_t n_edges,
                       const double* node_x, const double* node_y,
@@ -217,72 +420,11 @@ void rt_candidates(void* handle, int64_t n_points, const double* px,
                    int32_t* out_edge, float* out_dist, float* out_off,
                    float* out_px, float* out_py) {
   auto* g = static_cast<Graph*>(handle);
-  const double cell = g->cell;
-  const int64_t reach = static_cast<int64_t>(std::ceil(radius / cell));
-  struct Cand {
-    double d;  // double so tie-ordering matches the numpy float64 sort
-    int32_t e;
-    float off, qx, qy;
-  };
-  std::vector<Cand> cands;
-  std::vector<char> seen(g->n_edges, 0);
-  std::vector<int32_t> seen_list;
+  CandScratch scratch(g->n_edges);
   for (int64_t t = 0; t < n_points; ++t) {
-    cands.clear();
-    for (int32_t s : seen_list) seen[s] = 0;
-    seen_list.clear();
-    const double x = px[t], y = py[t];
-    const int64_t ci = static_cast<int64_t>(std::floor(x / cell));
-    const int64_t cj = static_cast<int64_t>(std::floor(y / cell));
-    for (int64_t i = ci - reach; i <= ci + reach; ++i) {
-      for (int64_t j = cj - reach; j <= cj + reach; ++j) {
-        auto it = g->cells.find(Graph::cell_key(i, j));
-        if (it == g->cells.end()) continue;
-        for (int32_t e : it->second) {
-          if (seen[e]) continue;
-          seen[e] = 1;
-          seen_list.push_back(e);
-          const double ax = g->node_x[g->edge_start[e]];
-          const double ay = g->node_y[g->edge_start[e]];
-          const double bx = g->node_x[g->edge_end[e]];
-          const double by = g->node_y[g->edge_end[e]];
-          const double dx = bx - ax, dy = by - ay;
-          const double len2 = std::max(dx * dx + dy * dy, 1e-9);
-          double f = ((x - ax) * dx + (y - ay) * dy) / len2;
-          f = std::min(1.0, std::max(0.0, f));
-          const double qx = ax + f * dx, qy = ay + f * dy;
-          const double d = std::hypot(x - qx, y - qy);
-          if (d <= radius) {
-            cands.push_back({d, e, static_cast<float>(f * g->edge_len[e]),
-                             static_cast<float>(qx), static_cast<float>(qy)});
-          }
-        }
-      }
-    }
-    const int32_t n = static_cast<int32_t>(
-        std::min<size_t>(cands.size(), static_cast<size_t>(k)));
-    // stable top-K by distance, ties by edge id (matches numpy stable sort
-    // over edge-id-ordered input)
-    std::stable_sort(cands.begin(), cands.end(), [](const Cand& a,
-                                                    const Cand& b) {
-      return a.d < b.d || (a.d == b.d && a.e < b.e);
-    });
-    for (int32_t s = 0; s < k; ++s) {
-      const int64_t o = t * k + s;
-      if (s < n) {
-        out_edge[o] = cands[s].e;
-        out_dist[o] = static_cast<float>(cands[s].d);
-        out_off[o] = cands[s].off;
-        out_px[o] = cands[s].qx;
-        out_py[o] = cands[s].qy;
-      } else {
-        out_edge[o] = kPadEdge;
-        out_dist[o] = kPadDist;
-        out_off[o] = 0.0f;
-        out_px[o] = 0.0f;
-        out_py[o] = 0.0f;
-      }
-    }
+    const int64_t o = t * k;
+    candidates_for_point(g, px[t], py[t], k, radius, scratch, out_edge + o,
+                         out_dist + o, out_off + o, out_px + o, out_py + o);
   }
 }
 
@@ -306,82 +448,526 @@ void rt_route_matrices(void* handle, int64_t T, int32_t K,
                        double turn_penalty_factor, float* out) {
   auto* g = static_cast<Graph*>(handle);
   for (int64_t t = 0; t + 1 < T; ++t) {
-    const float bound = static_cast<float>(
-        std::max(min_bound, factor * static_cast<double>(gc[t])));
-    // min_time_bound floors the cap the way min_bound floors the distance
-    // bound: at 1 Hz sampling factor*dt is ~2 s, which GPS noise alone
-    // overruns — without the floor the time bound prunes honest
-    // transitions instead of absurd detours.
-    const float time_cap =
-        (dt != nullptr && time_factor > 0 && dt[t] > 0)
-            ? static_cast<float>(std::max(min_time_bound, time_factor * dt[t]))
-            : -1.0f;  // no bound
-    for (int32_t i = 0; i < K; ++i) {
-      const int32_t ea = edge_ids[t * K + i];
-      float* row = out + (t * K + i) * K;
-      if (ea == kPadEdge) {
-        for (int32_t j = 0; j < K; ++j) row[j] = kUnreachable;
-        continue;
+    route_step(g, edge_ids + t * K, offsets + t * K, edge_ids + (t + 1) * K,
+               offsets + (t + 1) * K, K, gc[t], dt ? dt[t] : 0.0,
+               dt != nullptr, factor, min_bound, backward_tol, time_factor,
+               min_time_bound, turn_penalty_factor,
+               out + t * static_cast<int64_t>(K) * K);
+  }
+}
+
+// Whole-batch trace preparation: projection, candidate search, jitter/
+// no-candidate point selection, case codes, and route matrices for B
+// traces in ONE call, writing rows straight into the caller's padded
+// (B, T, ...) batch tensors. This is the framework's answer to the
+// reference's one-C++-Match-per-trace architecture
+// (reference: py/reporter_service.py:240) — per-trace Python and
+// per-trace ctypes round-trips were the measured end-to-end ceiling
+// (BENCH_r03: device decode ~4% of the leg).
+//
+// Inputs: flat per-point lat/lon/times (degrees / epoch secs) with
+// pt_off (B+1) trace offsets; (lat0, lon0) is the network projection
+// anchor (graph/network.py projection()). Semantics per trace mirror
+// matcher/batchpad.py prepare_trace exactly: points with no candidates
+// and points within interpolation_distance of the last kept point are
+// excluded; kept sequences cap at T (bucket truncation); case codes are
+// RESTART at t=0 and after breakage-sized gaps, NORMAL otherwise, SKIP
+// in the padding tail (pre-filled by the caller); route matrices and
+// time/turn bounds via route_step above. dt derives from times over
+// kept points when time_factor > 0.
+//
+// Caller pre-fills outputs with pad sentinels (SKIP case, kPadEdge,
+// kPadDist, kUnreachable, kept=-1); this call writes only the live
+// prefix rows of each trace. out_dwell gets the trailing jitter dwell
+// (batchpad.py:109-123 semantics). n_threads <= 0 picks
+// hardware_concurrency; traces fan out across threads (the route cache
+// is lock-striped; ctypes releases the GIL for the whole call).
+void rt_prepare_batch(void* handle, int64_t n_traces, const int64_t* pt_off,
+                      const double* lat, const double* lon,
+                      const double* times, double lat0, double lon0,
+                      int32_t T, int32_t K, double search_radius,
+                      double interpolation_distance,
+                      double breakage_distance, double factor,
+                      double min_bound, double backward_tol,
+                      double time_factor, double min_time_bound,
+                      double turn_penalty_factor, int32_t n_threads,
+                      int32_t* out_edge, float* out_dist, float* out_off,
+                      float* out_route, float* out_gc, int32_t* out_case,
+                      int32_t* out_kept, int32_t* out_num_kept,
+                      float* out_dwell) {
+  auto* g = static_cast<Graph*>(handle);
+  const double coslat0 = std::cos(lat0 * kRadPerDeg);
+  const int64_t TK = static_cast<int64_t>(T) * K;
+  const int64_t TKK = static_cast<int64_t>(T > 0 ? T - 1 : 0) * K * K;
+
+  auto prepare_one = [&](int64_t b, CandScratch& scratch,
+                         std::vector<int32_t>& edge_raw,
+                         std::vector<float>& dist_raw,
+                         std::vector<float>& off_raw,
+                         std::vector<int32_t>& kept,
+                         std::vector<double>& gc_kept) {
+    const int64_t p0 = pt_off[b], p1 = pt_off[b + 1];
+    const int64_t n_raw = p1 - p0;
+    int32_t* edge_b = out_edge + b * TK;
+    float* dist_b = out_dist + b * TK;
+    float* off_b = out_off + b * TK;
+    float* route_b = out_route + b * TKK;
+    float* gc_b = out_gc + b * (T > 0 ? T - 1 : 0);
+    int32_t* case_b = out_case + b * T;
+    int32_t* kept_b = out_kept + b * T;
+    out_num_kept[b] = 0;
+    out_dwell[b] = 0.0f;
+    if (n_raw <= 0) return;
+
+    // candidates for every raw point (projection inline)
+    edge_raw.resize(n_raw * K);
+    dist_raw.resize(n_raw * K);
+    off_raw.resize(n_raw * K);
+    for (int64_t p = 0; p < n_raw; ++p) {
+      const double x = (lon[p0 + p] - lon0) * kMetersPerDeg * coslat0;
+      const double y = (lat[p0 + p] - lat0) * kMetersPerDeg;
+      candidates_for_point(g, x, y, K, search_radius, scratch,
+                           edge_raw.data() + p * K, dist_raw.data() + p * K,
+                           off_raw.data() + p * K, nullptr, nullptr);
+    }
+
+    // kept selection: drop candidate-less points and jitter points within
+    // interpolation_distance of the last kept point (batchpad._select_kept)
+    kept.clear();
+    for (int64_t p = 0; p < n_raw; ++p) {
+      bool has = false;
+      for (int32_t q = 0; q < K; ++q)
+        if (edge_raw[p * K + q] != kPadEdge) {
+          has = true;
+          break;
+        }
+      if (!has) continue;
+      if (!kept.empty()) {
+        const int64_t lk = kept.back();
+        if (equirect_m(lat[p0 + lk], lon[p0 + lk], lat[p0 + p],
+                       lon[p0 + p]) < interpolation_distance)
+          continue;
       }
-      const float oa = offsets[t * K + i];
-      const float remaining = g->edge_len[ea] - oa;
-      const int32_t src = g->edge_end[ea];
-      // one bounded search from ea's end node covers every target j.
-      // The stripe lock is held across compute AND the row fill below:
-      // a concurrent bound-extension on the same src move-assigns the
-      // cached map, so reads must stay inside the critical section.
-      std::lock_guard<std::mutex> lock(g->stripe_for(src).mu);
-      const auto& dist = g->dists_from(src, bound);
-      for (int32_t j = 0; j < K; ++j) {
-        const int32_t eb = edge_ids[(t + 1) * K + j];
-        if (eb == kPadEdge) {
-          row[j] = kUnreachable;
-          continue;
-        }
-        const float ob = offsets[(t + 1) * K + j];
-        if (eb == ea && ob >= oa) {
-          row[j] = (time_cap >= 0 && g->edge_secs(ea, ob - oa) > time_cap)
-                       ? kUnreachable
-                       : ob - oa;
-          continue;
-        }
-        // forgive small apparent backward movement on the same directed
-        // edge (along-track GPS noise) — see graph/route.py route_distance
-        if (eb == ea && oa - ob <= backward_tol) {
-          row[j] = 0.0f;
-          continue;
-        }
-        const float via = remaining + ob;
-        if (via > bound) {
-          row[j] = kUnreachable;
-          continue;
-        }
-        auto it = dist.find(g->edge_start[eb]);
-        // reachable only if the whole route fits inside the bound, matching
-        // the python fallback's max_dist semantics (graph/route.py)
-        if (it == dist.end() || via + it->second.d > bound) {
-          row[j] = kUnreachable;
-          continue;
-        }
-        if (time_cap >= 0) {
-          const float secs = g->edge_secs(ea, remaining) +
-                             g->edge_secs(eb, ob) + it->second.t;
-          if (secs > time_cap) {
-            row[j] = kUnreachable;
-            continue;
+      kept.push_back(static_cast<int32_t>(p));
+    }
+    const bool truncated = kept.size() > static_cast<size_t>(T);
+    const int32_t n =
+        static_cast<int32_t>(std::min<size_t>(kept.size(), T));
+    out_num_kept[b] = n;
+    if (n == 0) return;
+
+    // trailing jitter dwell: every raw point after the last kept one has
+    // candidates and sits within interpolation_distance of it — the
+    // vehicle verifiably stayed put (batchpad.py:109-123)
+    if (!truncated && kept[n - 1] < n_raw - 1) {
+      const int64_t lk = kept[n - 1];
+      bool all_jitter = true;
+      for (int64_t p = lk + 1; p < n_raw && all_jitter; ++p) {
+        bool has = false;
+        for (int32_t q = 0; q < K; ++q)
+          if (edge_raw[p * K + q] != kPadEdge) {
+            has = true;
+            break;
           }
-        }
-        float d = via + it->second.d;
-        if (turn_penalty_factor > 0) {
-          const float cos_th = g->head_x[ea] * g->head_x[eb] +
-                               g->head_y[ea] * g->head_y[eb];
-          d += static_cast<float>(turn_penalty_factor) * 0.5f *
-               (1.0f - cos_th);
-        }
-        row[j] = d;
+        if (!has ||
+            equirect_m(lat[p0 + lk], lon[p0 + lk], lat[p0 + p],
+                       lon[p0 + p]) >= interpolation_distance)
+          all_jitter = false;
+      }
+      if (all_jitter)
+        out_dwell[b] =
+            static_cast<float>(times[p1 - 1] - times[p0 + lk]);
+    }
+
+    // gather kept rows into the padded outputs; gc + case codes
+    gc_kept.resize(n > 1 ? n - 1 : 0);
+    for (int32_t t = 0; t < n; ++t) {
+      const int64_t p = kept[t];
+      std::memcpy(edge_b + t * K, edge_raw.data() + p * K,
+                  K * sizeof(int32_t));
+      std::memcpy(dist_b + t * K, dist_raw.data() + p * K,
+                  K * sizeof(float));
+      std::memcpy(off_b + t * K, off_raw.data() + p * K, K * sizeof(float));
+      kept_b[t] = static_cast<int32_t>(p);
+      if (t > 0) {
+        const int64_t pp = kept[t - 1];
+        const double gc = equirect_m(lat[p0 + pp], lon[p0 + pp],
+                                     lat[p0 + p], lon[p0 + p]);
+        gc_kept[t - 1] = gc;
+        gc_b[t - 1] = static_cast<float>(gc);
+        // compare the FLOAT32 gc, as batchpad.prepare_trace does (it
+        // casts gc to f32 before the breakage test) — a gap within one
+        // f32 ulp of the threshold must split identically on both paths
+        case_b[t] = static_cast<double>(gc_b[t - 1]) > breakage_distance
+                        ? 1 /*RESTART*/
+                        : 0 /*NORMAL*/;
+      } else {
+        case_b[t] = 1;  // RESTART at the first kept point
       }
     }
+
+    // route matrices between consecutive kept candidate rows; dt from the
+    // kept points' probe times feeds the time-admissibility bound
+    const bool have_dt = time_factor > 0 && n > 1;
+    for (int32_t t = 0; t + 1 < n; ++t) {
+      const double dt_t =
+          have_dt ? times[p0 + kept[t + 1]] - times[p0 + kept[t]] : 0.0;
+      route_step(g, edge_b + t * K, off_b + t * K, edge_b + (t + 1) * K,
+                 off_b + (t + 1) * K, K, gc_b[t], dt_t, have_dt, factor,
+                 min_bound, backward_tol, time_factor, min_time_bound,
+                 turn_penalty_factor, route_b + static_cast<int64_t>(t) * K * K);
+    }
+  };
+
+  int32_t workers = n_threads > 0
+                        ? n_threads
+                        : static_cast<int32_t>(
+                              std::thread::hardware_concurrency());
+  workers = std::max(1, std::min<int32_t>(
+                            workers, static_cast<int32_t>(n_traces)));
+  if (workers == 1) {
+    CandScratch scratch(g->n_edges);
+    std::vector<int32_t> edge_raw, kept;
+    std::vector<float> dist_raw, off_raw;
+    std::vector<double> gc_kept;
+    for (int64_t b = 0; b < n_traces; ++b)
+      prepare_one(b, scratch, edge_raw, dist_raw, off_raw, kept, gc_kept);
+    return;
   }
+  std::atomic<int64_t> next{0};
+  std::vector<std::thread> pool;
+  pool.reserve(workers);
+  for (int32_t w = 0; w < workers; ++w) {
+    pool.emplace_back([&]() {
+      CandScratch scratch(g->n_edges);
+      std::vector<int32_t> edge_raw, kept;
+      std::vector<float> dist_raw, off_raw;
+      std::vector<double> gc_kept;
+      for (;;) {
+        const int64_t b = next.fetch_add(1);
+        if (b >= n_traces) return;
+        prepare_one(b, scratch, edge_raw, dist_raw, off_raw, kept, gc_kept);
+      }
+    });
+  }
+  for (auto& th : pool) th.join();
+}
+
+// f32 -> f16 (IEEE half) bulk conversion for the wire tensors
+// (matcher/batchpad.py). Round-to-nearest-even with overflow to +/-inf —
+// bit-identical to numpy.astype(float16). The numpy cast was the single
+// largest host cost after batching (BENCH round-4 profile: ~43% of
+// match_many); with F16C this is one instruction per 8 floats.
+void rt_f32_to_f16(const float* src, uint16_t* dst, int64_t n) {
+  int64_t i = 0;
+#ifdef __F16C__
+  for (; i + 8 <= n; i += 8) {
+    __m256 v = _mm256_loadu_ps(src + i);
+    _mm_storeu_si128(reinterpret_cast<__m128i*>(dst + i),
+                     _mm256_cvtps_ph(v, _MM_FROUND_TO_NEAREST_INT));
+  }
+#endif
+  for (; i < n; ++i) {
+    // scalar fallback: round-to-nearest-even via float bit manipulation
+    uint32_t x;
+    std::memcpy(&x, src + i, 4);
+    const uint32_t sign = (x >> 16) & 0x8000u;
+    x &= 0x7fffffffu;
+    uint16_t h;
+    if (x >= 0x47800000u) {                  // overflow / inf / nan
+      h = x > 0x7f800000u ? 0x7e00u : 0x7c00u;
+    } else if (x < 0x38800000u) {            // subnormal / zero
+      const float f = std::fabs(src[i]) * 0x1.0p+24f;  // scale into int range
+      uint32_t m = static_cast<uint32_t>(f);
+      const float r = f - static_cast<float>(m);
+      m += (r > 0.5f || (r == 0.5f && (m & 1u))) ? 1u : 0u;
+      h = static_cast<uint16_t>(m);
+    } else {
+      const uint32_t mant = x & 0xfffu;
+      x += 0xfffu + ((x >> 13) & 1u);        // round to nearest even
+      (void)mant;
+      h = static_cast<uint16_t>(((x - 0x38000000u) >> 13) & 0x7fffu);
+    }
+    dst[i] = h | sign;
+  }
+}
+
+}  // extern "C"
+
+// ---- batched segment assembly (matcher/assemble.py in C++) --------------
+// The decoded (B, T) candidate indices -> per-trace OSMLR segment runs,
+// walked entirely in native code; Python only formats the run records
+// into the reference-schema dicts (reference: py/reporter_service.py:103-162
+// consumes them). Semantics mirror matcher/assemble.py line for line; the
+// parity is pinned by tests (native batch vs pure-python assemble).
+
+namespace {
+
+constexpr double kBoundaryEps = 1.0;          // assemble.py _BOUNDARY_EPS
+constexpr double kQueueEndProximity = 100.0;  // _QUEUE_END_PROXIMITY_M
+constexpr int32_t kCaseRestart = 1;
+
+double interp_time(double pos, double pos_a, double pos_b, double ta,
+                   double tb) {
+  if (pos_b <= pos_a) return ta;
+  double frac = (pos - pos_a) / (pos_b - pos_a);
+  frac = std::min(std::max(frac, 0.0), 1.0);
+  return ta + frac * (tb - ta);
+}
+
+// segment length lookup over the sorted (seg_ids, seg_lens) columns;
+// returns fallback when absent (assemble.py uses .get(id, 0.0) for
+// interpolation and .get(id, -1.0) for output)
+double seg_len_of(const int64_t* ids, const double* lens, int64_t n,
+                  int64_t key, double fallback) {
+  const int64_t* it = std::lower_bound(ids, ids + n, key);
+  if (it != ids + n && *it == key) return lens[it - ids];
+  return fallback;
+}
+
+struct Run {
+  int64_t segment_id;  // -1 = unassociated stretch
+  bool internal;
+  int32_t first_idx, last_idx;
+  double first_pos, last_pos;
+  double first_time, last_time;
+  double first_cum, last_cum;
+  double start_time = -1.0, end_time = -1.0;
+  double queue_start;  // NaN while traffic is moving
+  bool has_queue_start = false;
+  std::vector<int64_t> edges;
+};
+
+}  // namespace
+
+extern "C" {
+
+// Returns total runs written (<= cap), or -1 if cap would overflow (the
+// caller sizes cap = sum(num_kept), which is a strict upper bound — each
+// chain element starts at most one run — so -1 indicates a caller bug).
+// Outputs: run_off (B+1) per-trace run ranges; per-run columns; way_off
+// (cap+1) + out_ways flat way-id lists (capacity also sum(num_kept)).
+int64_t rt_assemble_batch(
+    int64_t B, int32_t T, int32_t K, const int32_t* path,
+    const int32_t* edge_ids, const float* offset_m, const float* route_m,
+    const int32_t* case_codes, const int32_t* kept_idx,
+    const int32_t* num_kept, const float* dwell, const int64_t* pt_off,
+    const double* times, const int64_t* edge_seg_id,
+    const float* edge_seg_off, const uint8_t* edge_internal,
+    const int64_t* seg_ids_sorted, const double* seg_lens_sorted,
+    int64_t n_segs, double queue_threshold_kph,
+    double interpolation_distance_m, int64_t cap, int64_t* run_off,
+    int64_t* out_seg_id, uint8_t* out_internal, double* out_start,
+    double* out_end, int32_t* out_length, int32_t* out_queue,
+    int32_t* out_begin_idx, int32_t* out_end_idx, int64_t* way_off,
+    int64_t* out_ways) {
+  const int64_t TK = static_cast<int64_t>(T) * K;
+  const int64_t TKK = static_cast<int64_t>(T > 0 ? T - 1 : 0) * K * K;
+  int64_t r_total = 0;  // runs written
+  int64_t w_total = 0;  // way ids written
+  way_off[0] = 0;
+  std::vector<Run> runs;
+  // chain element: (orig_idx, edge, seg_id, seg_pos, time, cum, internal)
+  struct Elem {
+    int32_t idx;
+    int64_t edge, seg_id;
+    double seg_pos, time, cum;
+    bool internal;
+  };
+  std::vector<Elem> chain;
+
+  for (int64_t b = 0; b < B; ++b) {
+    run_off[b] = r_total;
+    const int32_t n = num_kept[b];
+    if (n == 0) continue;
+    const int32_t* path_b = path + b * T;
+    const int32_t* edge_b_rows = edge_ids + b * TK;
+    const float* off_b = offset_m + b * TK;
+    const float* route_b = route_m + b * TKK;
+    const int32_t* case_b = case_codes + b * T;
+    const int32_t* kept_b = kept_idx + b * T;
+    const double* times_b = times + pt_off[b];
+    const double trailing_dwell = dwell[b];
+
+    runs.clear();
+    chain.clear();
+
+    // emit the accumulated chain as runs (assemble.py _chain_to_segments)
+    auto flush_chain = [&](bool final_flush) {
+      if (chain.empty()) return;
+      const size_t first_run = runs.size();
+      for (const Elem& e : chain) {
+        const int64_t sid = e.seg_id >= 0 ? e.seg_id : -1;
+        bool same = false;
+        if (runs.size() > first_run) {
+          Run& last = runs.back();
+          same = last.segment_id == sid && last.internal == e.internal &&
+                 !(sid >= 0 && e.seg_pos < last.last_pos - kBoundaryEps);
+        }
+        if (same) {
+          Run& r = runs.back();
+          const double dt = e.time - r.last_time;
+          if (dt > 0.0) {
+            const double speed_kph = (e.seg_pos - r.last_pos) / dt * 3.6;
+            if (speed_kph < queue_threshold_kph) {
+              if (!r.has_queue_start) {
+                r.queue_start = r.last_pos;
+                r.has_queue_start = true;
+              }
+            } else {
+              r.has_queue_start = false;
+            }
+          }
+          r.last_idx = e.idx;
+          r.last_pos = e.seg_pos;
+          r.last_time = e.time;
+          r.last_cum = e.cum;
+          if (r.edges.back() != e.edge) r.edges.push_back(e.edge);
+        } else {
+          Run r;
+          r.segment_id = sid;
+          r.internal = e.internal;
+          r.first_idx = r.last_idx = e.idx;
+          r.first_pos = r.last_pos = e.seg_pos;
+          r.first_time = r.last_time = e.time;
+          r.first_cum = r.last_cum = e.cum;
+          r.edges.push_back(e.edge);
+          runs.push_back(std::move(r));
+        }
+      }
+      // trailing raw-point dwell: the dropped tail stayed within
+      // interpolation_distance for dwell seconds — if even the
+      // upper-bound speed (disc diameter / dwell) is below the queue
+      // threshold, the vehicle is queued at its last decoded position
+      if (final_flush && trailing_dwell > 0.0 && runs.size() > first_run) {
+        Run& last = runs.back();
+        const double bound_kph =
+            2.0 * interpolation_distance_m / trailing_dwell * 3.6;
+        if (bound_kph < queue_threshold_kph && !last.has_queue_start) {
+          last.queue_start = last.last_pos;
+          last.has_queue_start = true;
+        }
+      }
+      // interpolate boundary times between adjacent runs of this chain
+      for (size_t ri = first_run; ri + 1 < runs.size(); ++ri) {
+        Run& a = runs[ri];
+        Run& b2 = runs[ri + 1];
+        const double pos_a = a.last_cum, pos_b = b2.first_cum;
+        const double ta = a.last_time, tb = b2.first_time;
+        if (a.segment_id >= 0) {
+          const double seg_len = seg_len_of(seg_ids_sorted, seg_lens_sorted,
+                                            n_segs, a.segment_id, 0.0);
+          const double exit_cum =
+              a.last_cum + std::max(seg_len - a.last_pos, 0.0);
+          a.end_time = interp_time(exit_cum, pos_a, pos_b, ta, tb);
+        } else {
+          a.end_time = ta;
+        }
+        if (b2.segment_id >= 0) {
+          const double entry_cum = b2.first_cum - b2.first_pos;
+          b2.start_time = interp_time(entry_cum, pos_a, pos_b, ta, tb);
+        } else {
+          b2.start_time = tb;
+        }
+      }
+      // chain endpoints: partial entry/exit => -1 sentinels
+      if (runs.size() > first_run) {
+        Run& first = runs[first_run];
+        if (first.segment_id >= 0) {
+          if (first.first_pos <= kBoundaryEps)
+            first.start_time = first.first_time;
+          // else stays -1 (got on mid-segment)
+        } else {
+          first.start_time = first.first_time;
+        }
+        Run& last = runs.back();
+        if (last.segment_id >= 0) {
+          const double seg_len = seg_len_of(seg_ids_sorted, seg_lens_sorted,
+                                            n_segs, last.segment_id, 0.0);
+          if (last.last_pos >= seg_len - kBoundaryEps)
+            last.end_time = last.last_time;
+          // else stays -1 (still on the segment when the trace ended)
+        } else {
+          last.end_time = last.last_time;
+        }
+      }
+      chain.clear();
+    };
+
+    double cum = 0.0;
+    bool prev_ok = false;
+    for (int32_t t = 0; t < n; ++t) {
+      if (case_b[t] == kCaseRestart) {
+        flush_chain(false);
+        cum = 0.0;
+        prev_ok = false;
+      }
+      const int32_t k = path_b[t];
+      const int64_t e = edge_b_rows[t * K + k];
+      if (e == kPadEdge) {
+        flush_chain(false);
+        prev_ok = false;
+        continue;
+      }
+      if (prev_ok) {
+        const float step =
+            route_b[static_cast<int64_t>(t - 1) * K * K +
+                    static_cast<int64_t>(path_b[t - 1]) * K + k];
+        if (step >= kUnreachable / 2) {
+          // decoder was forced through an unroutable pair; break here
+          flush_chain(false);
+          cum = 0.0;
+        } else {
+          cum += static_cast<double>(step);
+        }
+      }
+      chain.push_back(Elem{
+          kept_b[t], e, edge_seg_id[e],
+          static_cast<double>(edge_seg_off[e]) +
+              static_cast<double>(off_b[t * K + k]),
+          times_b[kept_b[t]], cum, edge_internal[e] != 0});
+      prev_ok = true;
+    }
+    flush_chain(true);
+
+    // write this trace's runs to the flat outputs
+    if (r_total + static_cast<int64_t>(runs.size()) > cap) return -1;
+    std::fesetround(FE_TONEAREST);
+    for (const Run& r : runs) {
+      const bool complete =
+          r.segment_id >= 0 && r.start_time != -1.0 && r.end_time != -1.0;
+      const double seg_len =
+          r.segment_id >= 0
+              ? seg_len_of(seg_ids_sorted, seg_lens_sorted, n_segs,
+                           r.segment_id, -1.0)
+              : -1.0;
+      out_seg_id[r_total] = r.segment_id;
+      out_internal[r_total] = r.internal ? 1 : 0;
+      out_start[r_total] = r.start_time;
+      out_end[r_total] = r.end_time;
+      // rint (round-half-even) matches python round()
+      out_length[r_total] =
+          complete ? static_cast<int32_t>(std::rint(seg_len)) : -1;
+      int32_t q = 0;
+      if (r.segment_id >= 0 && r.has_queue_start) {
+        const double sl = std::max(seg_len, 0.0);
+        // only extrapolate to the segment end when the queue was actually
+        // observed near it (assemble.py _Run.queue_length)
+        if (sl > 0.0 && sl - r.last_pos <= kQueueEndProximity)
+          q = static_cast<int32_t>(
+              std::rint(std::max(sl - r.queue_start, 0.0)));
+      }
+      out_queue[r_total] = q;
+      out_begin_idx[r_total] = r.first_idx;
+      out_end_idx[r_total] = r.last_idx;
+      if (w_total + static_cast<int64_t>(r.edges.size()) > cap) return -1;
+      for (int64_t e : r.edges) out_ways[w_total++] = e;
+      way_off[r_total + 1] = w_total;
+      ++r_total;
+    }
+  }
+  run_off[B] = r_total;
+  return r_total;
 }
 
 }  // extern "C"

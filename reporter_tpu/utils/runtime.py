@@ -37,9 +37,30 @@ log = logging.getLogger(__name__)
 
 ENV_PLATFORM = "REPORTER_TPU_PLATFORM"          # cpu | accel | auto
 ENV_VIRTUAL_DEVICES = "REPORTER_TPU_VIRTUAL_DEVICES"
+ENV_PROBE_TIMEOUT = "REPORTER_TPU_PROBE_TIMEOUT_S"  # default 90
+ENV_PROBE_TRIES = "REPORTER_TPU_PROBE_TRIES"        # default 2
 _DEVICE_COUNT_FLAG = "xla_force_host_platform_device_count"
 
 _decided: str | None = None  # this process's platform decision, once made
+
+# diagnostics of the last ensure_backend decision, for artifacts (bench.py
+# embeds this in its JSON so a CPU-fallback run is distinguishable from a
+# broken build without reading logs)
+probe_info: dict = {}
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
 
 
 def _backends_initialized():
@@ -99,7 +120,8 @@ def force_virtual_cpu(n_devices: int | None = None) -> None:
     _decided = "cpu"
 
 
-def accelerator_available(timeout_s: float = 90.0, tries: int = 2) -> bool:
+def accelerator_available(timeout_s: float | None = None,
+                          tries: int | None = None) -> bool:
     """Probe whether the registered accelerator backend can initialise,
     without risking this process.
 
@@ -114,10 +136,17 @@ def accelerator_available(timeout_s: float = 90.0, tries: int = 2) -> bool:
     takes the forced-CPU path, whose factory-popping guarantees an
     unconstrained init can't still block on a half-working plugin.
     """
+    if timeout_s is None:
+        timeout_s = _env_float(ENV_PROBE_TIMEOUT, 90.0)
+    if tries is None:
+        tries = _env_int(ENV_PROBE_TRIES, 2)
+    probe_info.update({"timeout_s": timeout_s, "tries": tries,
+                       "attempts": 0, "reason": None})
     code = ("import jax; d = jax.devices(); "
             "print(d[0].platform); "
             "import sys; sys.exit(0 if d else 1)")
     for attempt in range(1, tries + 1):
+        probe_info["attempts"] = attempt
         try:
             proc = subprocess.run(
                 [sys.executable, "-c", code],
@@ -125,25 +154,31 @@ def accelerator_available(timeout_s: float = 90.0, tries: int = 2) -> bool:
         except subprocess.TimeoutExpired:
             log.warning("accelerator probe %d/%d timed out after %.0fs",
                         attempt, tries, timeout_s)
+            probe_info["reason"] = f"probe timed out after {timeout_s:.0f}s"
             continue
         lines = proc.stdout.strip().splitlines() if proc.stdout else []
         platform = lines[-1] if lines else ""
         if proc.returncode == 0 and platform and platform != "cpu":
             log.info("accelerator probe ok: platform=%s", platform)
+            probe_info["reason"] = f"probe ok: {platform}"
             return True
         if proc.returncode == 0:
             log.info("probe came up on %r — no accelerator", platform)
+            probe_info["reason"] = "probe came up on cpu — no accelerator"
             return False
         log.warning("accelerator probe %d/%d failed rc=%d: %s",
                     attempt, tries, proc.returncode,
                     proc.stderr.strip()[-300:])
+        probe_info["reason"] = (
+            f"probe failed rc={proc.returncode}: "
+            + proc.stderr.strip()[-120:])
     return False
 
 
 def ensure_backend(prefer: str | None = None,
                    n_virtual_devices: int | None = None,
-                   probe_timeout_s: float = 90.0,
-                   probe_tries: int = 2) -> str:
+                   probe_timeout_s: float | None = None,
+                   probe_tries: int | None = None) -> str:
     """Decide and pin this process's JAX platform. Returns "cpu" or the
     accelerator platform name.
 
@@ -160,12 +195,21 @@ def ensure_backend(prefer: str | None = None,
     if _decided is not None:
         return _decided
 
+    # probe patience is env-tunable (a flaky chip tunnel day should be a
+    # config change, not a code change); explicit args still win
+    if probe_timeout_s is None:
+        probe_timeout_s = _env_float(ENV_PROBE_TIMEOUT, 90.0)
+    if probe_tries is None:
+        probe_tries = _env_int(ENV_PROBE_TRIES, 2)
+
     choice = (prefer or os.environ.get(ENV_PLATFORM) or "auto").lower()
     if n_virtual_devices is None:
         env_n = os.environ.get(ENV_VIRTUAL_DEVICES)
         n_virtual_devices = int(env_n) if env_n else None
 
     if choice == "cpu":
+        probe_info.update({"platform": "cpu",
+                           "reason": f"forced cpu ({ENV_PLATFORM} or arg)"})
         force_virtual_cpu(n_virtual_devices)
         os.environ[ENV_PLATFORM] = "cpu"
         return "cpu"
@@ -195,6 +239,7 @@ def ensure_backend(prefer: str | None = None,
             log.warning("%s; falling back to CPU backend", e)
         else:
             _decided = platform
+            probe_info["platform"] = platform
             # deliberately NOT exported as "accel": a child inheriting
             # "accel" would take the unbounded-blocking explicit branch
             # while the parent holds the chip. Children re-probe under
@@ -203,6 +248,8 @@ def ensure_backend(prefer: str | None = None,
             return platform
 
     log.warning("accelerator unavailable; falling back to CPU backend")
+    probe_info["platform"] = "cpu"
+    probe_info.setdefault("reason", "accelerator unavailable")
     force_virtual_cpu(n_virtual_devices)
     os.environ[ENV_PLATFORM] = "cpu"
     return "cpu"

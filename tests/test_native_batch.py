@@ -1,0 +1,169 @@
+"""Whole-batch native prep + assembly vs the per-trace paths: identical.
+
+The round-4 hot path moved per-trace host work into two batch-level C++
+entry points (host_runtime.cpp rt_prepare_batch / rt_assemble_batch;
+reference architecture being replaced: one C++ Match per trace,
+py/reporter_service.py:240). These tests pin the parity contract:
+
+- rt_prepare_batch produces the same tensors as prepare_trace for every
+  trace in a mixed batch (kept selection, candidates, route matrices,
+  case codes, trailing dwell);
+- match_many through the native batch path returns byte-identical match
+  dicts to the pure-numpy per-trace fallback;
+- rt_f32_to_f16 is bit-identical to numpy's float16 cast (the wire
+  format both decode paths consume).
+"""
+import numpy as np
+import pytest
+
+from reporter_tpu import native
+from reporter_tpu.matcher import MatchParams, SegmentMatcher
+from reporter_tpu.matcher.batchpad import bucket_length, prepare_batch
+from reporter_tpu.synth import build_grid_city, generate_trace
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native toolchain unavailable")
+
+
+@pytest.fixture(scope="module")
+def city():
+    return build_grid_city(rows=10, cols=10, spacing_m=200.0, seed=5)
+
+
+@pytest.fixture(scope="module")
+def matcher(city):
+    return SegmentMatcher(net=city, params=MatchParams(max_candidates=8))
+
+
+@pytest.fixture(scope="module")
+def traces(city):
+    rng = np.random.default_rng(11)
+    out = []
+    while len(out) < 24:
+        tr = generate_trace(city, f"b{len(out)}", rng, noise_m=5.0,
+                            min_route_edges=3, max_route_edges=14)
+        if tr is not None and len(tr.points) >= 4:
+            tr.points = tr.points[:60]
+            out.append(tr)
+    return out
+
+
+def test_prepare_batch_matches_prepare_trace(matcher, traces):
+    params = matcher.params
+    pts = [tr.points for tr in traces]
+    olds = [matcher.prepare(p) for p in pts]
+    by_T = {}
+    for idx, p in enumerate(pts):
+        by_T.setdefault(bucket_length(max(len(p), 1)), []).append(idx)
+    for T, idxs in by_T.items():
+        batch = prepare_batch(matcher.runtime, [pts[i] for i in idxs],
+                              params, T, n_threads=2)
+        for row, i in enumerate(idxs):
+            old, new = olds[i], batch.traces[row]
+            assert old.num_kept == new.num_kept
+            nk = old.num_kept
+            np.testing.assert_array_equal(old.kept_idx, new.kept_idx)
+            np.testing.assert_array_equal(old.edge_ids[:nk],
+                                          new.edge_ids[:nk])
+            np.testing.assert_allclose(old.dist_m[:nk], new.dist_m[:nk],
+                                       rtol=1e-6, atol=1e-4)
+            np.testing.assert_allclose(old.offset_m[:nk],
+                                       new.offset_m[:nk],
+                                       rtol=1e-6, atol=1e-4)
+            if nk > 1:
+                np.testing.assert_allclose(old.route_m[:nk - 1],
+                                           new.route_m[:nk - 1],
+                                           rtol=1e-5, atol=1e-3)
+                np.testing.assert_allclose(old.gc_m[:nk - 1],
+                                           new.gc_m[:nk - 1],
+                                           rtol=1e-6, atol=1e-4)
+            nmin = min(old.T, T)
+            np.testing.assert_array_equal(old.case[:nmin], new.case[:nmin])
+            assert old.trailing_jitter_dwell_s == pytest.approx(
+                new.trailing_jitter_dwell_s, abs=1e-9)
+
+
+def test_prepare_batch_pad_rows_are_skip(matcher, traces):
+    from reporter_tpu.matcher.hmm import SKIP
+    pts = [traces[0].points]
+    batch = prepare_batch(matcher.runtime, pts, matcher.params, 64,
+                          pad_rows=4)
+    assert batch.case.shape[0] == 4
+    assert (batch.case[1:] == SKIP).all()
+    assert not batch.valid[1:].any()
+    assert len(batch.traces) == 1
+
+
+def test_match_many_native_equals_numpy_fallback(city, matcher, traces):
+    reqs = []
+    for tr in traces:
+        r = tr.request_json()
+        r["trace"] = tr.points
+        r["match_options"] = {"mode": "auto", "report_levels": [0, 1, 2],
+                              "transition_levels": [0, 1, 2]}
+        reqs.append(r)
+    res_native = matcher.match_many(reqs)
+    fallback = SegmentMatcher(net=city, params=matcher.params,
+                              use_native=False)
+    res_np = fallback.match_many(reqs)
+    assert res_native == res_np
+
+
+def test_match_many_native_equals_numpy_with_jitter_tail(city, matcher):
+    # a stalled vehicle: trailing jitter points exercise the dwell /
+    # queue_length path through the native batch assembler
+    rng = np.random.default_rng(3)
+    tr = None
+    while tr is None:
+        tr = generate_trace(city, "stall", rng, noise_m=4.0,
+                            min_route_edges=5, max_route_edges=12)
+    last = dict(tr.points[-1])
+    for s in range(1, 31):
+        p = dict(last)
+        p["time"] = last["time"] + s
+        p["lat"] = last["lat"] + rng.normal(0, 1e-6)
+        p["lon"] = last["lon"] + rng.normal(0, 1e-6)
+        tr.points.append(p)
+    req = tr.request_json()
+    req["trace"] = tr.points
+    req["match_options"] = {"mode": "auto", "report_levels": [0, 1, 2],
+                            "transition_levels": [0, 1, 2]}
+    res_native = matcher.match_many([req])
+    fallback = SegmentMatcher(net=city, params=matcher.params,
+                              use_native=False)
+    assert res_native == fallback.match_many([req])
+
+
+def test_f16_cast_bit_identical_to_numpy(matcher):
+    rng = np.random.default_rng(0)
+    a = (rng.standard_normal(100003)
+         * (10.0 ** rng.uniform(-6, 9, 100003))).astype(np.float32)
+    a[::97] = 1.0e9       # UNREACHABLE / PAD sentinels -> +inf
+    a[::31] = 0.0
+    a[1::53] = -a[1::53]
+    a[2::41] = 65504.0    # f16 max finite
+    a[3::67] = 65520.0    # first value rounding to +inf
+    with np.errstate(over="ignore"):
+        want = a.astype(np.float16)
+    got = matcher.runtime.to_f16(a)
+    np.testing.assert_array_equal(want.view(np.uint16),
+                                  got.view(np.uint16))
+
+
+def test_match_options_split_batches(matcher, traces):
+    # per-trace match_options that change prep params must not share a
+    # native prep call; results still line up with per-trace fallback
+    reqs = []
+    for j, tr in enumerate(traces[:8]):
+        r = tr.request_json()
+        r["trace"] = tr.points
+        opts = {"mode": "auto", "report_levels": [0, 1, 2],
+                "transition_levels": [0, 1, 2]}
+        if j % 2:
+            opts["search_radius"] = 35.0
+        r["match_options"] = opts
+        reqs.append(r)
+    res_native = matcher.match_many(reqs)
+    fallback = SegmentMatcher(net=matcher.net, params=matcher.params,
+                              use_native=False)
+    assert res_native == fallback.match_many(reqs)
