@@ -192,8 +192,12 @@ class PaddedBatch:
     traces: List[PreparedTrace]
     dist_m: np.ndarray   # (B, T, K) f32
     valid: np.ndarray    # (B, T, K) bool
-    route_m: np.ndarray  # (B, T-1, K, K) f32
-    gc_m: np.ndarray     # (B, T-1) f32
+    # route/gc time rows: T-1 on the numpy pack_batches path, T on the
+    # native prepare_batch path (dead trailing step so the dominant
+    # tensor shards along seq with zero pad copies); the decode kernels
+    # accept either and slice inside jit (matcher/hmm.py trim_time_pad)
+    route_m: np.ndarray  # (B, T-1 | T, K, K) f32
+    gc_m: np.ndarray     # (B, T-1 | T) f32
     case: np.ndarray     # (B, T) i32
     # native batched-prep extras (None on the per-trace fallback path):
     # the raw prepare_batch tensors + flat point arrays, consumed by the
@@ -233,10 +237,13 @@ def prepare_batch(runtime, traces_points: Sequence[Sequence[dict]],
     counts = [len(pts) for pts in traces_points]
     pt_off = np.zeros(B + 1, dtype=np.int64)
     np.cumsum(counts, out=pt_off[1:])
-    flat = [p for pts in traces_points for p in pts]
-    lat = np.fromiter((p["lat"] for p in flat), np.float64, len(flat))
-    lon = np.fromiter((p["lon"] for p in flat), np.float64, len(flat))
-    times = np.fromiter((p["time"] for p in flat), np.float64, len(flat))
+    n_pts = int(pt_off[-1])
+    lat = np.fromiter((p["lat"] for pts in traces_points for p in pts),
+                      np.float64, n_pts)
+    lon = np.fromiter((p["lon"] for pts in traces_points for p in pts),
+                      np.float64, n_pts)
+    times = np.fromiter((p["time"] for pts in traces_points for p in pts),
+                        np.float64, n_pts)
 
     out = runtime.prepare_batch(
         pt_off, lat, lon, times, T, params.max_candidates,
@@ -259,8 +266,12 @@ def prepare_batch(runtime, traces_points: Sequence[Sequence[dict]],
             num_raw=counts[b], num_kept=nk, kept_idx=kept[b, :nk],
             times=times[pt_off[b]:pt_off[b + 1]],
             edge_ids=edge_ids[b], dist_m=out["dist_m"][b],
-            offset_m=out["offset_m"][b], route_m=out["route_m"][b],
-            gc_m=out["gc_m"][b], case=out["case"][b],
+            offset_m=out["offset_m"][b],
+            # the batch tensors carry T time rows (dead trailing step,
+            # for seq sharding); the per-trace view keeps the documented
+            # (T-1, ...) contract — a contiguous slice, no copy
+            route_m=out["route_m"][b, :max(T - 1, 0)],
+            gc_m=out["gc_m"][b, :max(T - 1, 0)], case=out["case"][b],
             trailing_jitter_dwell_s=float(out["dwell"][b])))
 
     # wire dtype: one vectorised decision + cast for the whole batch

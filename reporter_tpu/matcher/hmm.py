@@ -127,6 +127,18 @@ def _viterbi_single(em: jnp.ndarray, tr: jnp.ndarray, case: jnp.ndarray):
     return path, jnp.max(final_scores)
 
 
+def trim_time_pad(dist_m, route_m, gc_m):
+    """Accept route/gc shipped with T time rows (a dead trailing step —
+    the native batched prep pads so the dominant tensor shards along the
+    seq mesh axis with zero host copies) or the classic T-1 rows; return
+    (T-1)-row views. Shape-static, so free under jit."""
+    Tm1 = dist_m.shape[-2] - 1
+    if route_m.shape[-3] == Tm1 + 1:
+        route_m = route_m[..., :Tm1, :, :]
+        gc_m = gc_m[..., :Tm1]
+    return route_m, gc_m
+
+
 @functools.partial(jax.jit, static_argnames=())
 def viterbi_decode_batch(dist_m: jnp.ndarray, valid: jnp.ndarray,
                          route_m: jnp.ndarray, gc_m: jnp.ndarray,
@@ -134,10 +146,13 @@ def viterbi_decode_batch(dist_m: jnp.ndarray, valid: jnp.ndarray,
                          beta: jnp.ndarray):
     """Decode a padded batch of traces.
 
-    Shapes: dist_m (B,T,K) f32; valid (B,T,K) bool; route_m (B,T-1,K,K) f32;
-    gc_m (B,T-1) f32; case (B,T) i32; sigma, beta scalars (f32).
+    Shapes: dist_m (B,T,K) f32; valid (B,T,K) bool; route_m (B,T-1,K,K)
+    f32 (or (B,T,K,K) with a dead last step — see trim_time_pad);
+    gc_m (B,T-1) f32 (or (B,T)); case (B,T) i32; sigma, beta scalars.
     Returns (paths (B,T) i32 candidate indices, scores (B,) f32).
     """
+    route_m, gc_m = trim_time_pad(dist_m, route_m, gc_m)
+
     def one(d, v, r, g, c):
         em = emission_scores(d, v, c, sigma)
         tr = transition_scores(r, g, c[1:], beta)

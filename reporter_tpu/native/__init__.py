@@ -30,7 +30,7 @@ _LIB_PATH = os.path.join(_DIR, "libreporter_host.so")
 # Must equal host_runtime.cpp's rt_abi_version(). The handshake in
 # _get_lib() turns a half-landed ABI change (library and binding updated
 # in different commits) into a loud numpy fallback instead of a segfault.
-ABI_VERSION = 4
+ABI_VERSION = 5
 _lib = None
 _build_lock = threading.Lock()
 _build_failed = False
@@ -305,9 +305,15 @@ class NativeRuntime:
         all-SKIP filler rows (mesh/pow2 batch padding).
 
         Returns a dict of the filled tensors: edge_ids (rows,T,K) i32,
-        dist_m/offset_m (rows,T,K) f32, route_m (rows,T-1,K,K) f32,
-        gc_m (rows,T-1) f32, case (rows,T) i32, kept_idx (rows,T) i32
+        dist_m/offset_m (rows,T,K) f32, route_m (rows,T,K,K) f32,
+        gc_m (rows,T) f32, case (rows,T) i32, kept_idx (rows,T) i32
         (-1 pad), num_kept (rows,) i32, dwell (rows,) f32.
+
+        route_m/gc_m carry T time rows — the final row is a dead step
+        left at its pre-fill — so the dominant tensor ships to the
+        device already shardable along the seq mesh axis, with no pad
+        copy anywhere on the path (parallel/sharded.py; the decode
+        kernels slice the dead step off inside jit).
         """
         pt_off = np.ascontiguousarray(pt_off, dtype=np.int64)
         lat = np.ascontiguousarray(lat, dtype=np.float64)
@@ -320,13 +326,12 @@ class NativeRuntime:
         from ..graph.spatial import PAD_DIST, PAD_EDGE
         from ..graph.route import UNREACHABLE
         from ..matcher.hmm import SKIP
-        Tm1 = max(T - 1, 0)
         out = {
             "edge_ids": np.full((rows, T, K), PAD_EDGE, np.int32),
             "dist_m": np.full((rows, T, K), PAD_DIST, np.float32),
             "offset_m": np.zeros((rows, T, K), np.float32),
-            "route_m": np.full((rows, Tm1, K, K), UNREACHABLE, np.float32),
-            "gc_m": np.zeros((rows, Tm1), np.float32),
+            "route_m": np.full((rows, T, K, K), UNREACHABLE, np.float32),
+            "gc_m": np.zeros((rows, T), np.float32),
             "case": np.full((rows, T), SKIP, np.int32),
             "kept_idx": np.full((rows, T), -1, np.int32),
             "num_kept": np.zeros(rows, np.int32),

@@ -373,7 +373,7 @@ extern "C" {
 // numpy path loudly instead of calling through a stale signature. BUMP
 // THIS on ANY change to the signatures below, in the same commit as the
 // Python-side constant.
-int32_t rt_abi_version(void) { return 4; }
+int32_t rt_abi_version(void) { return 5; }
 
 void* rt_graph_create(int64_t n_nodes, int64_t n_edges,
                       const double* node_x, const double* node_y,
@@ -498,7 +498,10 @@ void rt_prepare_batch(void* handle, int64_t n_traces, const int64_t* pt_off,
   auto* g = static_cast<Graph*>(handle);
   const double coslat0 = std::cos(lat0 * kRadPerDeg);
   const int64_t TK = static_cast<int64_t>(T) * K;
-  const int64_t TKK = static_cast<int64_t>(T > 0 ? T - 1 : 0) * K * K;
+  // route/gc rows are T per trace (not T-1): the final row is a dead
+  // step the caller pre-fills, so the (B, T, K, K) tensor shards along
+  // the seq mesh axis with no host-side pad copy (parallel/sharded.py)
+  const int64_t TKK = static_cast<int64_t>(T) * K * K;
 
   auto prepare_one = [&](int64_t b, CandScratch& scratch,
                          std::vector<int32_t>& edge_raw,
@@ -512,7 +515,7 @@ void rt_prepare_batch(void* handle, int64_t n_traces, const int64_t* pt_off,
     float* dist_b = out_dist + b * TK;
     float* off_b = out_off + b * TK;
     float* route_b = out_route + b * TKK;
-    float* gc_b = out_gc + b * (T > 0 ? T - 1 : 0);
+    float* gc_b = out_gc + b * T;
     int32_t* case_b = out_case + b * T;
     int32_t* kept_b = out_kept + b * T;
     out_num_kept[b] = 0;
@@ -762,7 +765,8 @@ int64_t rt_assemble_batch(
     int32_t* out_begin_idx, int32_t* out_end_idx, int64_t* way_off,
     int64_t* out_ways) {
   const int64_t TK = static_cast<int64_t>(T) * K;
-  const int64_t TKK = static_cast<int64_t>(T > 0 ? T - 1 : 0) * K * K;
+  // route rows are T per trace (dead trailing step) — see rt_prepare_batch
+  const int64_t TKK = static_cast<int64_t>(T) * K * K;
   int64_t r_total = 0;  // runs written
   int64_t w_total = 0;  // way ids written
   way_off[0] = 0;

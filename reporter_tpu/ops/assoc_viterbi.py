@@ -38,7 +38,7 @@ import jax
 import jax.numpy as jnp
 
 from ..matcher.hmm import (
-    NEG_INF, RESTART, emission_scores, transition_scores)
+    NEG_INF, RESTART, emission_scores, transition_scores, trim_time_pad)
 
 
 def _maxplus_matmul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
@@ -95,6 +95,8 @@ def viterbi_assoc_batch(dist_m: jnp.ndarray, valid: jnp.ndarray,
     for matcher.hmm.viterbi_decode_batch — same shapes, same path quality
     and total score (both accumulate across RESTART chains), with possible
     differences only where f32 ordering flips exact score ties."""
+    route_m, gc_m = trim_time_pad(dist_m, route_m, gc_m)
+
     def one(d, v, r, g, c):
         em = emission_scores(d, v, c, sigma)
         tr = transition_scores(r, g, c[1:], beta)

@@ -106,6 +106,8 @@ def viterbi_pallas_batch(dist_m: jnp.ndarray, valid: jnp.ndarray,
     forward recurrence fused into one Pallas program per batch block.
     ``interpret=True`` runs the kernel in the Pallas interpreter
     (CPU-testable, same numerics)."""
+    from ..matcher.hmm import trim_time_pad
+    route_m, gc_m = trim_time_pad(dist_m, route_m, gc_m)
     B, T, K = dist_m.shape
 
     em = jax.vmap(lambda d, v, c: emission_scores(d, v, c, sigma))(

@@ -25,15 +25,31 @@ def shard_batch(mesh: Mesh, dist_m, valid, route_m, gc_m, case):
     The batch axis must divide the ``data`` mesh axis and T the ``seq``
     axis (callers pad batches/buckets to multiples — batchpad's
     ``pad_batch_to`` exists for this).
+
+    ``route_m`` is the dominant tensor by a factor of K (B, T-1, K, K);
+    its ragged T-1 time axis is padded to T with one dead trailing step
+    so it shards along ``seq`` like everything else — per-device bytes
+    and h2d for the largest input drop by the seq factor (the round-3
+    weakness: it used to replicate along seq). The dead step is sliced
+    off inside the jitted decode and never scored.
     """
     def put(x, spec):
         return jax.device_put(x, NamedSharding(mesh, spec))
 
+    B, Tm1 = route_m.shape[0], route_m.shape[1]
+    T = dist_m.shape[1]
+    if Tm1 == T - 1:
+        route_m = np.concatenate(
+            [route_m, np.zeros((B, 1) + route_m.shape[2:],
+                               dtype=route_m.dtype)], axis=1)
+        gc_m = np.concatenate(
+            [gc_m, np.zeros((B, 1), dtype=gc_m.dtype)], axis=1)
+
     return (
         put(dist_m, P("data", "seq", None)),
         put(valid, P("data", "seq", None)),
-        put(route_m, P("data", None, None, None)),  # T-1 ragged: replicate
-        put(gc_m, P("data", None)),
+        put(route_m, P("data", "seq", None, None)),
+        put(gc_m, P("data", "seq")),
         put(case, P("data", "seq")),
     )
 
@@ -47,6 +63,9 @@ def sharded_viterbi(mesh: Mesh):
     out_sharding = (NamedSharding(mesh, P("data", "seq")),
                     NamedSharding(mesh, P("data")))
 
+    # route/gc arrive padded to T time rows (dead trailing step) so they
+    # shard along seq; the kernel itself sheds the dead step inside jit
+    # (matcher/hmm.py trim_time_pad) and GSPMD partitions the slice
     decode = jax.jit(viterbi_assoc_batch.__wrapped__,
                      out_shardings=out_sharding)
 

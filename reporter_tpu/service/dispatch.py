@@ -61,6 +61,46 @@ class BatchDispatcher:
             raise slot.error
         return slot.result  # type: ignore[return-value]
 
+    def submit_many(self, traces: Sequence[dict], timeout: float = 60.0,
+                    return_exceptions: bool = False) -> List[dict]:
+        """Enqueue a whole list, then wait: the dispatch loop drains them
+        into ONE device batch (up to max_batch; a longer list spans
+        several batches back-to-back). This is the streaming worker's
+        eviction path — N uuids flushed by one punctuate cycle decode as
+        one padded batch of N, not N batches of 1 (reference being
+        beaten: one C++ call per trace, Batch.java:66-68).
+
+        ``timeout`` is per device batch; the aggregate deadline scales
+        with how many batches the list needs, so a huge end-of-stream
+        flush cannot time out merely for being large. With
+        ``return_exceptions`` failures come back in-place (the exception
+        object in that trace's slot) instead of raising — a one-batch
+        failure then costs only that batch's traces, not the whole list.
+        """
+        if self._closed:
+            raise RuntimeError("dispatcher is closed")
+        slots = [_Slot(tr) for tr in traces]
+        for slot in slots:  # enqueue ALL before waiting on any
+            self._queue.put(slot)
+        n_batches = max(1, -(-len(slots) // self.max_batch))
+        deadline = time.monotonic() + timeout * n_batches
+        results: List = []
+        for slot in slots:
+            if not slot.event.wait(max(0.0, deadline - time.monotonic())):
+                err: Exception = TimeoutError(
+                    "match result not ready in time")
+                if not return_exceptions:
+                    raise err
+                results.append(err)
+                continue
+            if slot.error is not None:
+                if not return_exceptions:
+                    raise slot.error
+                results.append(slot.error)
+                continue
+            results.append(slot.result)
+        return results
+
     # ---- dispatch loop ---------------------------------------------------
     def _drain_batch(self) -> List[_Slot]:
         """Block for the first trace, then collect until flush conditions."""

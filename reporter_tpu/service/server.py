@@ -82,6 +82,35 @@ class ReporterService:
         except Exception as e:
             return 500, json.dumps({"error": str(e)})
 
+    def report_many(self, traces: list) -> list:
+        """Match + report a whole list in ONE dispatcher round trip (one
+        device batch up to MATCH_BATCH_MAX); returns parsed report dicts,
+        None for a trace that failed — a one-batch failure costs only
+        that batch's traces, and the cause is logged. The streaming
+        worker's in-process eviction path — no per-trace HTTP, no
+        per-trace JSON."""
+        import logging
+        log = logging.getLogger("reporter_tpu.service")
+        matches = self.dispatcher.submit_many(traces,
+                                              return_exceptions=True)
+        out = []
+        for trace, match in zip(traces, matches):
+            if isinstance(match, Exception):
+                log.error("batched match failed for %s: %s",
+                          trace.get("uuid"), match)
+                out.append(None)
+                continue
+            try:
+                opts = trace["match_options"]
+                out.append(report(match, trace, self.threshold_sec,
+                                  set(opts["report_levels"]),
+                                  set(opts["transition_levels"])))
+            except Exception as e:
+                log.error("report build failed for %s: %s",
+                          trace.get("uuid"), e)
+                out.append(None)
+        return out
+
 
 def make_handler(service: ReporterService):
     class Handler(BaseHTTPRequestHandler):

@@ -66,27 +66,28 @@ class Batch:
             "trace": [p.to_json_obj() for p in self.points],
         }
 
-    def report(self, uuid: str, submit: Callable[[dict], Optional[dict]],
-               mode: str, report_on: str, transition_on: str,
-               min_dist: float, min_size: int, min_elapsed: float
-               ) -> Optional[dict]:
-        """Fire a report if thresholds are met; trim consumed points."""
-        if self.max_separation < min_dist or len(self.points) < min_size or \
-                self.points[-1].time - self.points[0].time < min_elapsed:
+    def should_report(self, min_dist: float, min_size: int,
+                      min_elapsed: float) -> bool:
+        return not (self.max_separation < min_dist
+                    or len(self.points) < min_size
+                    or self.points[-1].time - self.points[0].time
+                    < min_elapsed)
+
+    def drop(self) -> None:
+        self.max_separation = 0.0
+        self.points.clear()
+
+    def apply_response(self, uuid: str,
+                       response: Optional[dict]) -> Optional[dict]:
+        """Trim consumed points per the response's ``shape_used``; a None
+        (failed round trip) or unusable response drops the batch, like an
+        unparseable response does in the reference (Batch.java:83-87)."""
+        if response is None:
+            logger.error("Match submit failed for %s", uuid)
+            self.drop()
             return None
         try:
-            response = submit(self.request_body(uuid, mode, report_on,
-                                                transition_on))
-        except Exception as e:
-            # a failed round trip drops the batch, like an unparseable
-            # response does in the reference
-            logger.error("Match submit failed for %s: %s", uuid, e)
-            self.max_separation = 0.0
-            self.points.clear()
-            return None
-        try:
-            trim_to = response.get("shape_used", len(self.points)) \
-                if response is not None else len(self.points)
+            trim_to = response.get("shape_used", len(self.points))
             del self.points[:trim_to]
             self.max_separation = 0.0
             first = self.points[0] if self.points else None
@@ -96,10 +97,26 @@ class Batch:
                     equirectangular_m(p.lat, p.lon, first.lat, first.lon))
             return response
         except Exception:
-            # unusable response: drop everything (reference: Batch.java:83-87)
-            self.max_separation = 0.0
-            self.points.clear()
+            self.drop()
             return None
+
+    def report(self, uuid: str, submit: Callable[[dict], Optional[dict]],
+               mode: str, report_on: str, transition_on: str,
+               min_dist: float, min_size: int, min_elapsed: float
+               ) -> Optional[dict]:
+        """Fire a report if thresholds are met; trim consumed points."""
+        if not self.should_report(min_dist, min_size, min_elapsed):
+            return None
+        try:
+            response = submit(self.request_body(uuid, mode, report_on,
+                                                transition_on))
+        except Exception as e:
+            # a failed round trip drops the batch, like an unparseable
+            # response does in the reference
+            logger.error("Match submit failed for %s: %s", uuid, e)
+            self.drop()
+            return None
+        return self.apply_response(uuid, response)
 
 
 def segments_from_response(response: Optional[dict]) -> List[Tuple[str, Segment]]:
@@ -144,14 +161,28 @@ class PointBatcher:
                  forward: Callable[[str, Segment], None],
                  mode: str = "auto", report_on: str = "0,1",
                  transition_on: str = "0,1",
-                 session_gap_ms: int = SESSION_GAP_MS):
+                 session_gap_ms: int = SESSION_GAP_MS,
+                 submit_many: Optional[Callable[
+                     [List[dict]], List[Optional[dict]]]] = None):
         self.submit = submit
+        # batched submit for the eviction path (one device batch for a
+        # whole punctuate flush); falls back to per-uuid submit
+        self.submit_many = submit_many or (
+            lambda bodies: [self._submit_safe(b) for b in bodies])
         self.forward = forward
         self.mode = mode
         self.report_on = report_on
         self.transition_on = transition_on
         self.session_gap_ms = session_gap_ms
         self.store: Dict[str, Batch] = {}
+
+    def _submit_safe(self, body: dict) -> Optional[dict]:
+        try:
+            return self.submit(body)
+        except Exception as e:
+            logger.error("Match submit failed for %s: %s",
+                         body.get("uuid"), e)
+            return None
 
     def _forward_all(self, response: Optional[dict]) -> int:
         n = 0
@@ -176,12 +207,25 @@ class PointBatcher:
 
     def punctuate(self, stream_time_ms: int) -> None:
         """Evict batches idle past the session gap, reporting what we can
-        with relaxed thresholds (reference: BatchingProcessor.java:87-106)."""
+        with relaxed thresholds (reference: BatchingProcessor.java:87-106).
+
+        All evicted uuids flush through ONE ``submit_many`` call, so a
+        punctuate cycle evicting N sessions decodes as one padded device
+        batch of N — not N batches of 1 (the round-1..3 weakness; the
+        reference can only do one C++ call per trace, Batch.java:66-68).
+        """
+        due = []
         for uuid in list(self.store):
             batch = self.store[uuid]
             if stream_time_ms - batch.last_update > self.session_gap_ms:
                 del self.store[uuid]
-                response = batch.report(
-                    uuid, self.submit, self.mode, self.report_on,
-                    self.transition_on, 0, 2, 0)
-                self._forward_all(response)
+                if batch.should_report(0, 2, 0):
+                    due.append((uuid, batch))
+        if not due:
+            return
+        bodies = [batch.request_body(uuid, self.mode, self.report_on,
+                                     self.transition_on)
+                  for uuid, batch in due]
+        responses = self.submit_many(bodies)
+        for (uuid, batch), response in zip(due, responses):
+            self._forward_all(batch.apply_response(uuid, response))

@@ -76,7 +76,8 @@ class StreamWorker:
                  session_gap_ms: int = SESSION_GAP_MS,
                  clock=time.time,
                  state=None,
-                 uuid_filter: Optional[Callable[[str], bool]] = None):
+                 uuid_filter: Optional[Callable[[str], bool]] = None,
+                 submit_many=None):
         self.formatter = formatter
         # multi-host: predicate deciding which uuids this worker owns
         # (parallel.multihost — the Kafka keyed-partition contract when the
@@ -87,7 +88,7 @@ class StreamWorker:
         self.batcher = PointBatcher(
             submit, lambda key, seg: self.anonymiser.process(key, seg),
             mode=mode, report_on=reports, transition_on=transitions,
-            session_gap_ms=session_gap_ms)
+            session_gap_ms=session_gap_ms, submit_many=submit_many)
         self.flush_interval_s = flush_interval_s
         self.session_gap_ms = session_gap_ms
         self.clock = clock
@@ -157,6 +158,32 @@ class StreamWorker:
         self.drain()
 
 
+def resolve_uuid_filter(mode: str, bootstrap: Optional[str]):
+    """Decide the multi-host uuid ownership filter.
+
+    The sha1 filter makes N workers reading one SHARED unpartitioned
+    stream process each uuid exactly once — Kafka's keyed-partition
+    contract without Kafka (parallel.multihost). But when the input IS a
+    Kafka consumer group (``bootstrap`` set), the group already
+    partitions messages across workers; composing the sha1 filter on top
+    silently drops ~(N-1)/N of each worker's share (the round-1..3
+    composition bug). So: ``auto`` = filter on for shared inputs, OFF
+    under a consumer group; ``on``/``off`` force it (``on`` is for
+    unkeyed topics, where group partitioning does not follow uuid —
+    with a loud warning).
+    """
+    from ..parallel import host_uuid_filter
+    if mode == "off" or (mode == "auto" and bootstrap):
+        return None
+    uuid_filter = host_uuid_filter()
+    if bootstrap and uuid_filter is not None:
+        logger.warning(
+            "--uuid-filter=on with a Kafka consumer group: unless the "
+            "topic is unkeyed, group partitioning x sha1 filter drops "
+            "most messages on every worker")
+    return uuid_filter
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(
         prog="reporter-stream",
@@ -179,6 +206,12 @@ def main(argv=None):
     parser.add_argument("--input", default="-",
                         help="flat file to replay, '-' for stdin")
     parser.add_argument("-b", "--bootstrap", help="Kafka bootstrap servers")
+    parser.add_argument("--uuid-filter", choices=("auto", "on", "off"),
+                        default="auto",
+                        help="multi-host uuid ownership filter: auto = on "
+                        "for shared unpartitioned inputs, OFF when a Kafka "
+                        "consumer group already partitions (--bootstrap); "
+                        "on/off force it")
     parser.add_argument("-t", "--topics",
                         help="comma-separated topics; first is raw input")
     parser.add_argument("--state-file",
@@ -198,15 +231,14 @@ def main(argv=None):
         ensure_backend()
 
     # joins a multi-host JAX job when REPORTER_TPU_COORDINATOR etc. are
-    # set; single-host no-op otherwise. The uuid filter makes N workers
-    # reading one shared (unpartitioned) stream process each uuid exactly
-    # once — Kafka's keyed-partition contract without Kafka.
-    from ..parallel import host_uuid_filter, init_multihost
+    # set; single-host no-op otherwise
+    from ..parallel import init_multihost
     init_multihost()
-    uuid_filter = host_uuid_filter()
+    uuid_filter = resolve_uuid_filter(args.uuid_filter, args.bootstrap)
 
     if args.reporter_url:
         submit = http_submitter(args.reporter_url)
+        submit_many = None  # HTTP path: one POST per trace (split deploy)
     else:
         from ..graph.network import RoadNetwork
         from ..matcher import SegmentMatcher
@@ -216,6 +248,9 @@ def main(argv=None):
         service = ReporterService(
             SegmentMatcher(net=RoadNetwork.load(args.graph)))
         submit = inproc_submitter(service)
+        # batched submit for eviction flushes: one dispatcher round trip
+        # -> one padded device batch (ReporterService.report_many)
+        submit_many = service.report_many
 
     state = None
     if args.state_file:
@@ -228,7 +263,7 @@ def main(argv=None):
                    args.quantisation, mode=args.mode, source=args.source),
         mode=args.mode, reports=args.reports, transitions=args.transitions,
         flush_interval_s=args.flush_interval, state=state,
-        uuid_filter=uuid_filter)
+        uuid_filter=uuid_filter, submit_many=submit_many)
 
     if args.bootstrap:
         from .broker import KafkaBroker

