@@ -69,6 +69,7 @@ class RoadNetwork:
     _node_y: Optional[np.ndarray] = None
     _proj: Optional[tuple] = None               # (to_xy, to_ll)
     _anchor: Optional[tuple] = None             # (lat0, lon0)
+    _headings: Optional[np.ndarray] = None      # (E, 2) unit headings
 
     @property
     def num_nodes(self) -> int:
@@ -100,6 +101,19 @@ class RoadNetwork:
             to_xy, _ = self.projection()
             self._node_x, self._node_y = to_xy(self.node_lat, self.node_lon)
         return self._node_x, self._node_y
+
+    def headings(self) -> np.ndarray:
+        """(E, 2) unit heading per edge in projected meters
+        (straight-segment geometry, matching the native runtime's
+        head_x/head_y); cached — turn-penalty pricing and its removal in
+        assembly both read this per decoded transition."""
+        if self._headings is None:
+            nx, ny = self.node_xy()
+            dx = nx[self.edge_end] - nx[self.edge_start]
+            dy = ny[self.edge_end] - ny[self.edge_start]
+            n = np.maximum(np.hypot(dx, dy), 1e-9)
+            self._headings = np.stack([dx / n, dy / n], axis=1)
+        return self._headings
 
     # ---- adjacency -------------------------------------------------------
     def csr(self):

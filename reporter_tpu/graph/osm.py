@@ -13,18 +13,25 @@ load-historical-data/setup.sh:49-53). This framework owns its graph format
 - one directed edge per consecutive node pair; two-way roads emit both
   directions; ``oneway``/roundabout semantics honoured.
 - speeds from ``maxspeed`` (kph or "N mph"), else per-class defaults.
-- OSMLR association synthesised per (way, direction): each drivable way
-  becomes one OSMLR segment whose 64-bit id packs the hierarchy level, the
-  level's geographic tile of the way's first node, and a per-tile running
-  index (core/osmlr.py bit layout). ``service`` roads and internal edges
-  (``*_link`` ramps, roundabouts) stay unassociated, mirroring how the
-  reference treats no-OSMLR and internal edges in report()
+- OSMLR association synthesised per (way, direction), SPLIT at decision
+  points the way real OSMLR segments are: a new segment starts at every
+  interior node shared with another drivable way (an intersection) and
+  whenever the running length passes ~1 km — so a 3 km avenue through
+  town becomes a chain of block-to-block segments, not one monolith, and
+  complete-traversal semantics (length=-1 otherwise, reference
+  README.md "Reporter Output") are meaningful. Each segment's 64-bit id
+  packs the hierarchy level, the level's geographic tile of the
+  segment's first node, and a per-tile running index (core/osmlr.py bit
+  layout). ``service`` roads and internal edges (``*_link`` ramps,
+  roundabouts) stay unassociated, mirroring how the reference treats
+  no-OSMLR and internal edges in report()
   (reference: py/reporter_service.py:119-127,161-162).
 
-This is a deliberate simplification of real OSMLR (which merges ways into
-longer traffic segments): ids are valid, level/tile bits are geographically
-correct, and every reporting code path (levels, tile bucketing, privacy,
-CSV) behaves exactly as with authentic ids.
+Remaining simplification vs real OSMLR: segments never merge ACROSS ways
+(real OSMLR chains same-road ways). Ids are valid, level/tile bits are
+geographically correct, and every reporting code path (levels, tile
+bucketing, privacy, CSV, complete-traversal reporting) behaves as with
+authentic ids.
 """
 from __future__ import annotations
 
@@ -54,6 +61,9 @@ _HIGHWAY_CLASSES: Dict[str, tuple] = {
 # roads as unassociated and ramps/roundabouts as internal)
 _UNASSOCIATED = {"service"}
 _INTERNAL_SUFFIX = "_link"
+# OSMLR segments cap out around a kilometre; longer stretches between
+# intersections split so complete-traversal reporting stays fine-grained
+_MAX_SEGMENT_LEN_M = 1000.0
 
 
 def _parse_speed(val: str, default: float) -> float:
@@ -169,6 +179,20 @@ def network_from_osm_xml(source: Union[str, IO[bytes]]) -> RoadNetwork:
         seg_counters[key] = idx + 1
         return make_segment_id(level, tile_idx, idx)
 
+    # decision points: nodes referenced by more than one drivable way (or
+    # more than once by the same way — a self-loop junction). Real OSMLR
+    # segments break at these; segment splitting below follows suit.
+    way_count: Dict[int, int] = {}
+    for _tags, refs in ways:
+        local: Dict[int, int] = {}
+        for r in refs:
+            local[r] = local.get(r, 0) + 1
+        for r, c in local.items():
+            # a node referenced twice by ONE way (closed ring) is a
+            # decision point too: count it as two uses so the split
+            # triggers at the loop-closure node
+            way_count[r] = way_count.get(r, 0) + (2 if c > 1 else 1)
+
     for tags, refs in ways:
         cls = tags.get("highway", "")
         level, cls_speed = _HIGHWAY_CLASSES[cls]
@@ -179,6 +203,7 @@ def network_from_osm_xml(source: Union[str, IO[bytes]]) -> RoadNetwork:
         oneway = _is_oneway(tags)
 
         nodes = [needed[r] for r in refs]
+        is_junction = [way_count.get(r, 0) > 1 for r in refs]
         seg_len = [equirectangular_m(lat[a], lon[a], lat[b], lon[b])
                    for a, b in zip(nodes[:-1], nodes[1:])]
         total = float(sum(seg_len))
@@ -187,16 +212,18 @@ def network_from_osm_xml(source: Union[str, IO[bytes]]) -> RoadNetwork:
 
         directions = []
         if oneway >= 0:
-            directions.append(nodes)
+            directions.append((nodes, seg_len, is_junction))
         if oneway <= 0:
-            directions.append(nodes[::-1])
-        for chain in directions:
+            directions.append((nodes[::-1], seg_len[::-1],
+                               is_junction[::-1]))
+        for chain, lens, junction in directions:
+            # split the way into OSMLR segments at interior decision
+            # points and at the ~1 km length cap; offsets restart at 0
+            # within each segment
             seg_id = next_segment_id(level, chain[0]) if associated else -1
-            if seg_id >= 0:
-                segment_length[seg_id] = total
-            lens = seg_len if chain is nodes else seg_len[::-1]
             off = 0.0
-            for (a, b), L in zip(zip(chain[:-1], chain[1:]), lens):
+            for step, ((a, b), L) in enumerate(
+                    zip(zip(chain[:-1], chain[1:]), lens)):
                 e_start.append(a)
                 e_end.append(b)
                 e_len.append(float(L))
@@ -205,6 +232,14 @@ def network_from_osm_xml(source: Union[str, IO[bytes]]) -> RoadNetwork:
                 e_off.append(off if seg_id >= 0 else 0.0)
                 e_internal.append(internal)
                 off += float(L)
+                interior = step + 1 < len(chain) - 1
+                if seg_id >= 0 and interior and (
+                        junction[step + 1] or off >= _MAX_SEGMENT_LEN_M):
+                    segment_length[seg_id] = off
+                    seg_id = next_segment_id(level, chain[step + 1])
+                    off = 0.0
+            if seg_id >= 0:
+                segment_length[seg_id] = off
 
     # compact to nodes actually used by surviving edges: dropped/clipped
     # ways leave orphans (and NaN coords for nodes absent from the

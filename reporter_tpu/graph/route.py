@@ -177,13 +177,8 @@ def route_distance(net: RoadNetwork, edge_a: int, offset_a: float,
 
 
 def _edge_headings(net: RoadNetwork) -> np.ndarray:
-    """(E, 2) unit heading per edge in projected meters (straight-segment
-    geometry, matching the native runtime's head_x/head_y)."""
-    nx, ny = net.node_xy()
-    dx = nx[net.edge_end] - nx[net.edge_start]
-    dy = ny[net.edge_end] - ny[net.edge_start]
-    n = np.maximum(np.hypot(dx, dy), 1e-9)
-    return np.stack([dx / n, dy / n], axis=1)
+    """(E, 2) unit heading per edge (cached on the network)."""
+    return net.headings()
 
 
 def candidate_route_matrices(net: RoadNetwork, cands: CandidateSet,

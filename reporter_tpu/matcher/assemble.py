@@ -105,11 +105,19 @@ class _Run:
 def assemble_segments(net: RoadNetwork, prepared, path: np.ndarray,
                       mode: str = "auto",
                       queue_threshold_kph: float = 10.0,
-                      interpolation_distance_m: float = 10.0) -> dict:
+                      interpolation_distance_m: float = 10.0,
+                      backward_tolerance_m: float = 25.0,
+                      turn_penalty_factor: float = 0.0) -> dict:
     """Build the match dict for one trace.
 
     ``prepared`` is a PreparedTrace (host tensors incl. times);
     ``path`` is the device-decoded (T,) candidate index per point.
+    ``turn_penalty_factor`` must echo the matcher's: route_m prices
+    heading changes INTO its distances for Viterbi ranking (Meili
+    semantics), but cumulative route positions here must be geometric —
+    the penalty is subtracted back out along the decoded path, else
+    boundary interpolation and the traversal-consistency checks read
+    penalty meters as road meters.
     """
     n = int(prepared.num_kept)
     if n == 0:
@@ -131,6 +139,15 @@ def assemble_segments(net: RoadNetwork, prepared, path: np.ndarray,
     restarts = prepared.case[:n] == RESTART
     steps = prepared.route_m[np.arange(n - 1), ks[:-1], ks[1:]] if n > 1 \
         else np.zeros(0, dtype=np.float32)
+    if turn_penalty_factor > 0 and n > 1:
+        # strip the ranking-only turn penalty from the decoded steps
+        # (reachable ones; same-edge transitions price no penalty and
+        # their cos term is 1, so the correction is uniformly safe)
+        heads = net.headings()
+        cos_th = np.einsum("ij,ij->i", heads[safe[:-1]], heads[safe[1:]])
+        penalty = turn_penalty_factor * 0.5 * (1.0 - cos_th)
+        steps = np.where(steps < UNREACHABLE / 2,
+                         np.maximum(steps - penalty, 0.0), steps)
 
     edges_l = edges.tolist()
     pad_l = pad.tolist()
@@ -165,7 +182,8 @@ def assemble_segments(net: RoadNetwork, prepared, path: np.ndarray,
             segments.extend(_chain_to_segments(
                 net, chain, queue_threshold_kph,
                 trailing_dwell_s=trailing_dwell_s if final else 0.0,
-                interpolation_distance_m=interpolation_distance_m))
+                interpolation_distance_m=interpolation_distance_m,
+                backward_tolerance_m=backward_tolerance_m))
         chain.clear()
 
     cum = 0.0
@@ -198,7 +216,15 @@ def assemble_segments(net: RoadNetwork, prepared, path: np.ndarray,
 def _chain_to_segments(net: RoadNetwork, chain: List[tuple],
                        queue_threshold_kph: float = 10.0,
                        trailing_dwell_s: float = 0.0,
-                       interpolation_distance_m: float = 10.0) -> List[dict]:
+                       interpolation_distance_m: float = 10.0,
+                       backward_tolerance_m: float = 25.0) -> List[dict]:
+    # a re-entry onto the same segment starts a new run — but apparent
+    # backward movement within the matcher's backward tolerance is
+    # along-track GPS noise (the same phenomenon route_distance prices as
+    # staying put), not a loop back onto the segment; splitting on it
+    # shatters one traversal into several partial runs and loses the
+    # complete-traversal report
+    reentry_tol = max(_BOUNDARY_EPS, backward_tolerance_m)
     # group the chain into runs of one segment (or one unassociated stretch)
     runs: List[_Run] = []
     for idx, edge, seg_id, seg_pos, time, cum, internal in chain:
@@ -207,8 +233,8 @@ def _chain_to_segments(net: RoadNetwork, chain: List[tuple],
             runs
             and runs[-1].segment_id == sid
             and runs[-1].internal == internal
-            # a re-entry onto the same segment starts a new run
-            and not (sid is not None and seg_pos < runs[-1].last_pos - _BOUNDARY_EPS)
+            and not (sid is not None
+                     and seg_pos < runs[-1].last_pos - reentry_tol)
         )
         if same:
             runs[-1].extend(idx, seg_pos, time, cum, edge,
@@ -229,7 +255,17 @@ def _chain_to_segments(net: RoadNetwork, chain: List[tuple],
         if bound_kph < queue_threshold_kph and last_run.queue_start is None:
             last_run.queue_start = last_run.last_pos
 
-    # interpolate boundary times between adjacent runs
+    # interpolate boundary times between adjacent runs. The boundary
+    # crossing must actually lie on the route between the two straddling
+    # probes: a claimed exit (segment end) beyond the next probe's route
+    # position, or a claimed entry (segment start) before the previous
+    # probe's, means the route never traversed that part of the segment —
+    # a one-point flicker onto a crossing way at an intersection would
+    # otherwise read as a COMPLETE traversal of the whole crossing
+    # segment (clamped interpolation hid the contradiction). The
+    # reference's native matcher derives completeness from actual edge
+    # traversal (starts/ends flags); this check is the time-domain
+    # equivalent.
     for a, b in zip(runs[:-1], runs[1:]):
         # time as a function of cumulative route position between the two
         # probes straddling the boundary
@@ -238,27 +274,47 @@ def _chain_to_segments(net: RoadNetwork, chain: List[tuple],
         if a.segment_id is not None:
             seg_len = net.segment_length_m.get(a.segment_id, 0.0)
             exit_cum = a.last_cum + max(seg_len - a.last_pos, 0.0)
-            a.end_time = _interp_time(exit_cum, pos_a, pos_b, ta, tb)
+            if exit_cum <= pos_b + _BOUNDARY_EPS:
+                a.end_time = _interp_time(exit_cum, pos_a, pos_b, ta, tb)
+            # else: exit unobserved; end_time stays -1
         else:
             a.end_time = ta
         if b.segment_id is not None:
             entry_cum = b.first_cum - b.first_pos
-            b.start_time = _interp_time(entry_cum, pos_a, pos_b, ta, tb)
+            if entry_cum >= pos_a - _BOUNDARY_EPS:
+                b.start_time = _interp_time(entry_cum, pos_a, pos_b, ta, tb)
+            # else: entry unobserved; start_time stays -1
         else:
             b.start_time = tb
 
-    # chain endpoints: partial entry/exit => -1 sentinels
+    # chain endpoints: partial entry/exit => -1 sentinels. The "at the
+    # boundary" test tolerates THREE interpolation distances: a trace
+    # that genuinely starts/ends at a segment node projects a few meters
+    # inside it (candidate projection carries the GPS noise), the jitter
+    # filter may have dropped the true final probe (anything within one
+    # interpolation distance of the last kept point), and sampling stops
+    # up to a probe interval before the physical route end — a 1 m eps
+    # would mark nearly every genuine end-to-end traversal partial
+    end_tol = max(_BOUNDARY_EPS, 3.0 * interpolation_distance_m)
     if runs:
+        # a single-point run that is BOTH chain endpoints gets no grants:
+        # one probe cannot witness a traversal, and with the widened
+        # tolerance a short segment's lone re-fed straddling probe (the
+        # shape_used overlap) would otherwise read as a second complete
+        # traversal at every window boundary
+        lone_point = (len(runs) == 1
+                      and runs[0].first_idx == runs[0].last_idx)
         first = runs[0]
-        if first.segment_id is not None and first.first_pos <= _BOUNDARY_EPS:
-            first.start_time = first.first_time
+        if first.segment_id is not None and first.first_pos <= end_tol:
+            if not lone_point:
+                first.start_time = first.first_time
         elif first.segment_id is None:
             first.start_time = first.first_time
         # else stays -1 (got on mid-segment)
         last = runs[-1]
         if last.segment_id is not None:
             seg_len = net.segment_length_m.get(last.segment_id, 0.0)
-            if last.last_pos >= seg_len - _BOUNDARY_EPS:
+            if last.last_pos >= seg_len - end_tol and not lone_point:
                 last.end_time = last.last_time
             # else stays -1 (still on the segment when the trace ended)
         else:

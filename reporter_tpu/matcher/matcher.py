@@ -265,7 +265,9 @@ class SegmentMatcher:
                         decoded[:B], batch.prep, batch.pt_off,
                         batch.times_flat,
                         queue_threshold_kph=gp.queue_speed_threshold_kph,
-                        interpolation_distance_m=gp.interpolation_distance)
+                        interpolation_distance_m=gp.interpolation_distance,
+                        backward_tolerance_m=gp.backward_tolerance_m,
+                        turn_penalty_factor=gp.turn_penalty_factor)
                     ro = runs["run_off"]
                     for b, i in enumerate(order):
                         results[i] = _format_runs(
@@ -279,7 +281,9 @@ class SegmentMatcher:
                     results[i] = assemble_segments(
                         self.net, p, decoded[b], mode=params.mode,
                         queue_threshold_kph=params.queue_speed_threshold_kph,
-                        interpolation_distance_m=params.interpolation_distance)
+                        interpolation_distance_m=params.interpolation_distance,
+                        backward_tolerance_m=params.backward_tolerance_m,
+                        turn_penalty_factor=params.turn_penalty_factor)
         return results
 
     # every param that shapes the prepared tensors or the batched

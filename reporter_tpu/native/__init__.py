@@ -30,7 +30,7 @@ _LIB_PATH = os.path.join(_DIR, "libreporter_host.so")
 # Must equal host_runtime.cpp's rt_abi_version(). The handshake in
 # _get_lib() turns a half-landed ABI change (library and binding updated
 # in different commits) into a loud numpy fallback instead of a segfault.
-ABI_VERSION = 5
+ABI_VERSION = 7
 _lib = None
 _build_lock = threading.Lock()
 _build_failed = False
@@ -116,11 +116,12 @@ def _get_lib() -> Optional[ctypes.CDLL]:
         lib.rt_f32_to_f16.argtypes = [c_f32p, c_u16p, ctypes.c_int64]
         lib.rt_assemble_batch.restype = ctypes.c_int64
         lib.rt_assemble_batch.argtypes = [
-            ctypes.c_int64, ctypes.c_int32, ctypes.c_int32,
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int32, ctypes.c_int32,
             c_i32p, c_i32p, c_f32p, c_f32p, c_i32p, c_i32p, c_i32p, c_f32p,
             c_i64p, c_f64p,
             c_i64p, c_f32p, c_u8p, c_i64p, c_f64p, ctypes.c_int64,
-            ctypes.c_double, ctypes.c_double, ctypes.c_int64,
+            ctypes.c_double, ctypes.c_double, ctypes.c_double,
+            ctypes.c_double, ctypes.c_int64,
             c_i64p, c_i64p, c_u8p, c_f64p, c_f64p, c_i32p, c_i32p,
             c_i32p, c_i32p, c_i64p, c_i64p]
         lib.rt_prepare_batch.argtypes = [
@@ -387,7 +388,9 @@ class NativeRuntime:
 
     def assemble_batch(self, path, prep: dict, pt_off, times,
                        queue_threshold_kph: float,
-                       interpolation_distance_m: float):
+                       interpolation_distance_m: float,
+                       backward_tolerance_m: float = 25.0,
+                       turn_penalty_factor: float = 0.0):
         """Walk B decoded paths into segment runs in ONE native call.
 
         ``path`` (B, T) decoded candidate indices (live rows only);
@@ -417,7 +420,7 @@ class NativeRuntime:
             "ways": np.empty(cap, np.int64),
         }
         n = self._lib.rt_assemble_batch(
-            B, T, K, path,
+            self._handle, B, T, K, path,
             prep["edge_ids"][:B], prep["offset_m"][:B],
             prep["route_m"][:B], prep["case"][:B], prep["kept_idx"][:B],
             np.ascontiguousarray(num_kept, dtype=np.int32),
@@ -428,6 +431,7 @@ class NativeRuntime:
             cols["edge_internal"], cols["seg_ids"], cols["seg_lens"],
             len(cols["seg_ids"]),
             float(queue_threshold_kph), float(interpolation_distance_m),
+            float(backward_tolerance_m), float(turn_penalty_factor),
             cap, run_off, out["seg_id"], out["internal"], out["start"],
             out["end"], out["length"], out["queue"], out["begin_idx"],
             out["end_idx"], out["way_off"], out["ways"])

@@ -373,7 +373,7 @@ extern "C" {
 // numpy path loudly instead of calling through a stale signature. BUMP
 // THIS on ANY change to the signatures below, in the same commit as the
 // Python-side constant.
-int32_t rt_abi_version(void) { return 5; }
+int32_t rt_abi_version(void) { return 7; }
 
 void* rt_graph_create(int64_t n_nodes, int64_t n_edges,
                       const double* node_x, const double* node_y,
@@ -751,7 +751,7 @@ extern "C" {
 // Outputs: run_off (B+1) per-trace run ranges; per-run columns; way_off
 // (cap+1) + out_ways flat way-id lists (capacity also sum(num_kept)).
 int64_t rt_assemble_batch(
-    int64_t B, int32_t T, int32_t K, const int32_t* path,
+    void* handle, int64_t B, int32_t T, int32_t K, const int32_t* path,
     const int32_t* edge_ids, const float* offset_m, const float* route_m,
     const int32_t* case_codes, const int32_t* kept_idx,
     const int32_t* num_kept, const float* dwell, const int64_t* pt_off,
@@ -759,11 +759,13 @@ int64_t rt_assemble_batch(
     const float* edge_seg_off, const uint8_t* edge_internal,
     const int64_t* seg_ids_sorted, const double* seg_lens_sorted,
     int64_t n_segs, double queue_threshold_kph,
-    double interpolation_distance_m, int64_t cap, int64_t* run_off,
+    double interpolation_distance_m, double backward_tolerance_m,
+    double turn_penalty_factor, int64_t cap, int64_t* run_off,
     int64_t* out_seg_id, uint8_t* out_internal, double* out_start,
     double* out_end, int32_t* out_length, int32_t* out_queue,
     int32_t* out_begin_idx, int32_t* out_end_idx, int64_t* way_off,
     int64_t* out_ways) {
+  const auto* g = static_cast<const Graph*>(handle);
   const int64_t TK = static_cast<int64_t>(T) * K;
   // route rows are T per trace (dead trailing step) — see rt_prepare_batch
   const int64_t TKK = static_cast<int64_t>(T) * K * K;
@@ -800,13 +802,18 @@ int64_t rt_assemble_batch(
     auto flush_chain = [&](bool final_flush) {
       if (chain.empty()) return;
       const size_t first_run = runs.size();
+      // re-entry splits a run, but backward movement within the
+      // matcher's backward tolerance is along-track GPS noise, not a
+      // loop (matcher/assemble.py _chain_to_segments has the rationale)
+      const double reentry_tol =
+          std::max(kBoundaryEps, backward_tolerance_m);
       for (const Elem& e : chain) {
         const int64_t sid = e.seg_id >= 0 ? e.seg_id : -1;
         bool same = false;
         if (runs.size() > first_run) {
           Run& last = runs.back();
           same = last.segment_id == sid && last.internal == e.internal &&
-                 !(sid >= 0 && e.seg_pos < last.last_pos - kBoundaryEps);
+                 !(sid >= 0 && e.seg_pos < last.last_pos - reentry_tol);
         }
         if (same) {
           Run& r = runs.back();
@@ -852,7 +859,12 @@ int64_t rt_assemble_batch(
           last.has_queue_start = true;
         }
       }
-      // interpolate boundary times between adjacent runs of this chain
+      // interpolate boundary times between adjacent runs of this chain.
+      // The crossing must lie ON the route between the straddling probes
+      // (matcher/assemble.py has the full rationale: a clamped interp
+      // would read a one-point intersection flicker as a complete
+      // traversal of the crossing segment) — unobserved exits/entries
+      // keep their -1 sentinel.
       for (size_t ri = first_run; ri + 1 < runs.size(); ++ri) {
         Run& a = runs[ri];
         Run& b2 = runs[ri + 1];
@@ -863,22 +875,36 @@ int64_t rt_assemble_batch(
                                             n_segs, a.segment_id, 0.0);
           const double exit_cum =
               a.last_cum + std::max(seg_len - a.last_pos, 0.0);
-          a.end_time = interp_time(exit_cum, pos_a, pos_b, ta, tb);
+          if (exit_cum <= pos_b + kBoundaryEps)
+            a.end_time = interp_time(exit_cum, pos_a, pos_b, ta, tb);
+          // else: exit unobserved; end_time stays -1
         } else {
           a.end_time = ta;
         }
         if (b2.segment_id >= 0) {
           const double entry_cum = b2.first_cum - b2.first_pos;
-          b2.start_time = interp_time(entry_cum, pos_a, pos_b, ta, tb);
+          if (entry_cum >= pos_a - kBoundaryEps)
+            b2.start_time = interp_time(entry_cum, pos_a, pos_b, ta, tb);
+          // else: entry unobserved; start_time stays -1
         } else {
           b2.start_time = tb;
         }
       }
-      // chain endpoints: partial entry/exit => -1 sentinels
+      // chain endpoints: partial entry/exit => -1 sentinels. Boundary
+      // proximity tolerates one interpolation distance of GPS noise
+      // (matcher/assemble.py has the rationale)
+      const double end_tol =
+          std::max(kBoundaryEps, 3.0 * interpolation_distance_m);
       if (runs.size() > first_run) {
+        // a single-point run that is BOTH chain endpoints gets no
+        // grants — one probe cannot witness a traversal
+        // (matcher/assemble.py has the window-boundary rationale)
+        const bool lone_point =
+            runs.size() == first_run + 1 &&
+            runs[first_run].first_idx == runs[first_run].last_idx;
         Run& first = runs[first_run];
         if (first.segment_id >= 0) {
-          if (first.first_pos <= kBoundaryEps)
+          if (first.first_pos <= end_tol && !lone_point)
             first.start_time = first.first_time;
           // else stays -1 (got on mid-segment)
         } else {
@@ -888,7 +914,7 @@ int64_t rt_assemble_batch(
         if (last.segment_id >= 0) {
           const double seg_len = seg_len_of(seg_ids_sorted, seg_lens_sorted,
                                             n_segs, last.segment_id, 0.0);
-          if (last.last_pos >= seg_len - kBoundaryEps)
+          if (last.last_pos >= seg_len - end_tol && !lone_point)
             last.end_time = last.last_time;
           // else stays -1 (still on the segment when the trace ended)
         } else {
@@ -914,7 +940,7 @@ int64_t rt_assemble_batch(
         continue;
       }
       if (prev_ok) {
-        const float step =
+        float step =
             route_b[static_cast<int64_t>(t - 1) * K * K +
                     static_cast<int64_t>(path_b[t - 1]) * K + k];
         if (step >= kUnreachable / 2) {
@@ -922,6 +948,20 @@ int64_t rt_assemble_batch(
           flush_chain(false);
           cum = 0.0;
         } else {
+          if (turn_penalty_factor > 0) {
+            // strip the ranking-only turn penalty: cumulative route
+            // positions must be geometric meters, not penalty meters
+            // (matcher/assemble.py has the rationale)
+            const int64_t e_prev =
+                edge_b_rows[static_cast<int64_t>(t - 1) * K +
+                            path_b[t - 1]];
+            const float cos_th = g->head_x[e_prev] * g->head_x[e] +
+                                 g->head_y[e_prev] * g->head_y[e];
+            step = std::max(
+                step - static_cast<float>(turn_penalty_factor) * 0.5f *
+                           (1.0f - cos_th),
+                0.0f);
+          }
           cum += static_cast<double>(step);
         }
       }

@@ -25,12 +25,12 @@ VMEM budget gates dispatch: a (T, K) bucket needs roughly
 (T*K + 2*T*K + (T-1)*K*K) * 128 * 4 bytes resident; buckets beyond the
 budget fall back to the associative path (ops/__init__.decode_batch).
 
-Measured (one real chip, B=512/T=64/K=8): end-to-end service throughput
-ties the assoc backend (~2250 traces/s; host assembly dominates), while
-device-resident decode measured slower than assoc through the chip
-tunnel (~64 ms vs ~26 ms per 512 traces) — hence opt-in via
-REPORTER_TPU_DECODE=pallas rather than the default, pending profiling
-on directly-attached hardware.
+The kernel stays opt-in via REPORTER_TPU_DECODE=pallas rather than the
+default: no RECORDED hardware run has shown it beating the assoc backend
+(and only assoc shards along seq). bench.py measures a pallas leg on
+every TPU run and records it in the artifact (the "pallas" field of
+BENCH_r*.json) — performance claims for this kernel live there, not
+here.
 """
 from __future__ import annotations
 

@@ -61,7 +61,19 @@ def report(match: dict, trace: dict, threshold_sec: float,
         last_idx -= 1
     shape_used: Optional[int] = None
     if last_idx >= 0:
-        shape_used = segs[last_idx]["begin_shape_index"]
+        # keep the boundary-straddling probe: the reference trims at the
+        # in-progress segment's first point (reporter_service.py:92), but
+        # without the last probe of the PRECEDING segment the next window
+        # can never interpolate this segment's entry time, so every
+        # window-boundary segment would be reported partial (length -1)
+        # and dropped — a systematic hole in the datastore stream at
+        # every batch trim. The preceding run's end_shape_index is the
+        # straddling probe even when jitter-dropped points sit between
+        # the runs.
+        if last_idx > 0:
+            shape_used = segs[last_idx - 1]["end_shape_index"]
+        else:
+            shape_used = max(segs[0]["begin_shape_index"] - 1, 0)
 
     match["mode"] = "auto"
     reports = []

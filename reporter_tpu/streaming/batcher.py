@@ -87,7 +87,19 @@ class Batch:
             self.drop()
             return None
         try:
-            trim_to = response.get("shape_used", len(self.points))
+            if "shape_used" in response:
+                trim_to = response["shape_used"]
+            elif response.get("segment_matcher", {}).get("segments"):
+                # segments matched but none consumed yet (the service
+                # omits a falsy shape_used — reference quirk): everything
+                # is still in-progress context, so keep it all. Trimming
+                # to len(points) here would throw away the in-progress
+                # segment AND the straddling probe the next window needs.
+                trim_to = 0
+            else:
+                # nothing matched at all: the context is worthless;
+                # consume it for forward progress (reference behavior)
+                trim_to = len(self.points)
             del self.points[:trim_to]
             self.max_separation = 0.0
             first = self.points[0] if self.points else None

@@ -30,9 +30,10 @@ def score(net, matcher, traces) -> dict:
       (strict; counts the inherently ambiguous ±1-point boundary cases)
     - ``segment_*``: the reported segment stream — the datastore contract.
       Precision over emitted *complete* segments (length > 0), recall over
-      truth segments fully traversed (all but the partial first/last).
-      This is the metric BASELINE.md's >=99% north star is about: clients
-      consume (segment_id, next_id, duration) rows, not per-point paths.
+      the truth path's end-to-end traversals
+      (SyntheticTrace.truth_complete_segments). This is the metric
+      BASELINE.md's >=99% north star is about: clients consume
+      (segment_id, next_id, duration) rows, not per-point paths.
     """
     matches = matcher.match_many([tr.request_json() for tr in traces])
     agree = total = 0
@@ -57,17 +58,21 @@ def score(net, matcher, traces) -> dict:
         total += t_total
         per_trace.append(t_agree / t_total if t_total else 1.0)
 
-        truth_seq = tr.truth_segments(net)
+        # the datastore contract is about COMPLETE traversals (length > 0
+        # only when the segment was covered end to end — reference
+        # README.md "Reporter Output"): precision = emitted completes the
+        # truth really did traverse fully; recall = truth's full
+        # traversals the matcher reported complete
+        truth_complete = tr.truth_complete_segments(net)
         complete = [s["segment_id"] for s in match["segments"]
                     if s.get("segment_id") is not None
                     and s.get("length", -1) > 0]
-        tset = set(truth_seq)
+        tset = set(truth_complete)
         emitted += len(complete)
         spurious += sum(1 for sid in complete if sid not in tset)
-        interior = truth_seq[1:-1]
-        truth_full += len(interior)
+        truth_full += len(truth_complete)
         got = set(complete)
-        truth_found += sum(1 for sid in interior if sid in got)
+        truth_found += sum(1 for sid in truth_complete if sid in got)
     seg_precision = 1.0 - spurious / emitted if emitted else 0.0
     seg_recall = truth_found / truth_full if truth_full else 1.0
     return {
@@ -88,6 +93,12 @@ def main(argv=None):
         formatter_class=argparse.RawDescriptionHelpFormatter)
     parser.add_argument("--graph", help="RoadNetwork .npz; omit for a "
                         "default synthetic city")
+    parser.add_argument("--osm", help="OSM XML file to import and match "
+                        "on (the real import path: graph/osm.py)")
+    parser.add_argument("--osm-fixture", action="store_true",
+                        help="use the deterministic non-grid OSM city "
+                        "(tools/osm_fixture.py) through the real OSM "
+                        "import path")
     parser.add_argument("--rows", type=int, default=16)
     parser.add_argument("--cols", type=int, default=16)
     parser.add_argument("--spacing-m", type=float, default=200.0)
@@ -95,7 +106,15 @@ def main(argv=None):
     parser.add_argument("--noise-m", type=float, default=4.0)
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--min-agreement", type=float, default=0.0,
-                        help="exit 1 if agreement falls below this")
+                        help="exit 1 if (segment) agreement falls below "
+                        "this")
+    parser.add_argument("--min-point-agreement", type=float, default=0.0,
+                        help="exit 1 if STRICT per-point agreement falls "
+                        "below this")
+    parser.add_argument("--turn-penalty-factor", type=float, default=500.0,
+                        help="matcher turn penalty; the reference's own "
+                        "accuracy harness uses 500 "
+                        "(generate_test_trace.py:172)")
     args = parser.parse_args(argv)
 
     from ..matcher import SegmentMatcher
@@ -109,6 +128,16 @@ def main(argv=None):
     if args.graph:
         from ..graph.network import RoadNetwork
         net = RoadNetwork.load(args.graph)
+    elif args.osm or args.osm_fixture:
+        import io
+
+        from ..graph.osm import network_from_osm_xml
+        if args.osm:
+            net = network_from_osm_xml(args.osm)
+        else:
+            from .osm_fixture import build_city_xml
+            net = network_from_osm_xml(io.BytesIO(
+                build_city_xml().encode()))
     else:
         # no service/internal edges: ground truth on those is ambiguous
         # by design (the matcher must *not* report them)
@@ -116,7 +145,9 @@ def main(argv=None):
                               spacing_m=args.spacing_m, seed=args.seed,
                               service_road_fraction=0.0,
                               internal_fraction=0.0)
-    matcher = SegmentMatcher(net=net)
+    from ..matcher import MatchParams
+    matcher = SegmentMatcher(net=net, params=MatchParams(
+        turn_penalty_factor=args.turn_penalty_factor))
 
     rng = np.random.default_rng(args.seed)
     traces = []
@@ -139,6 +170,10 @@ def main(argv=None):
     if result["agreement"] < args.min_agreement:
         print(f"FAIL: agreement {result['agreement']} < "
               f"{args.min_agreement}", file=sys.stderr)
+        return 1
+    if result["point_agreement"] < args.min_point_agreement:
+        print(f"FAIL: point_agreement {result['point_agreement']} < "
+              f"{args.min_point_agreement}", file=sys.stderr)
         return 1
     return 0
 

@@ -21,6 +21,9 @@ OSM_XML = """<?xml version="1.0" encoding="UTF-8"?>
   <node id="5" lat="14.5820" lon="121.0010"/>
   <node id="6" lat="14.5800" lon="121.0010"/>
   <node id="7" lat="14.5830" lon="121.0000"/>
+  <node id="8" lat="14.5840" lon="121.0010"/>
+  <node id="9" lat="14.5850" lon="121.0010"/>
+  <node id="10" lat="14.5860" lon="121.0010"/>
   <way id="100">
     <nd ref="1"/><nd ref="2"/><nd ref="3"/>
     <tag k="highway" v="primary"/>
@@ -53,6 +56,11 @@ OSM_XML = """<?xml version="1.0" encoding="UTF-8"?>
     <nd ref="7"/><nd ref="999"/>
     <tag k="highway" v="residential"/>
   </way>
+  <way id="107">
+    <nd ref="8"/><nd ref="9"/><nd ref="10"/>
+    <tag k="highway" v="residential"/>
+    <tag k="oneway" v="yes"/>
+  </way>
 </osm>
 """
 
@@ -71,8 +79,9 @@ class TestImport:
     def test_counts(self, net):
         # way 100: 2 node pairs x 2 dirs = 4; way 101: 1; way 102: 1;
         # way 103: 2 dirs? no - _link is internal but still two-way: 2;
-        # way 104 service two-way: 2; footway skipped; clipped way dropped
-        assert net.num_edges == 4 + 1 + 1 + 2 + 2
+        # way 104 service two-way: 2; way 107 oneway 2 pairs: 2;
+        # footway skipped; clipped way dropped
+        assert net.num_edges == 4 + 1 + 1 + 2 + 2 + 2
 
     def test_two_way_and_oneway(self, net):
         s = net.edge_start.tolist()
@@ -116,10 +125,30 @@ class TestImport:
         assert a != b and a >= 0 and b >= 0
         assert segment_index(a) != segment_index(b)
 
-    def test_segment_offsets_cumulative(self, net):
-        # second edge of the primary chain starts where the first ends
+    def test_segments_split_at_junctions(self, net):
+        # way 100 passes through node 2, which way 101 also uses — a
+        # decision point, so the OSMLR segment SPLITS there (real OSMLR
+        # breaks at intersections); each piece restarts its offsets and
+        # carries its own length
         e1 = _edges_between(net, 0, 1)[0]
         e2 = _edges_between(net, 1, 2)[0]
+        assert int(net.edge_segment_id[e1]) != int(net.edge_segment_id[e2])
+        assert net.edge_segment_offset_m[e1] == pytest.approx(0.0)
+        assert net.edge_segment_offset_m[e2] == pytest.approx(0.0)
+        for e in (e1, e2):
+            sid = int(net.edge_segment_id[e])
+            assert net.segment_length_m[sid] == pytest.approx(
+                float(net.edge_length_m[e]), rel=1e-5)
+
+    def test_segment_offsets_cumulative_between_junctions(self, net):
+        # way 107's interior node 9 belongs to no other way: NOT a
+        # decision point, so both edges share one segment with
+        # cumulative offsets
+        import numpy as np
+        lat9 = 14.5850
+        n9 = int(np.argmin(np.abs(net.node_lat - lat9)))
+        e1 = [e for e in range(net.num_edges) if net.edge_end[e] == n9][0]
+        e2 = [e for e in range(net.num_edges) if net.edge_start[e] == n9][0]
         assert int(net.edge_segment_id[e1]) == int(net.edge_segment_id[e2])
         assert net.edge_segment_offset_m[e1] == pytest.approx(0.0)
         assert net.edge_segment_offset_m[e2] == pytest.approx(
@@ -181,7 +210,10 @@ class TestQueueLength:
             pts.append({"lat": 14.58146 + i * 1.5e-5, "lon": 121.0,
                         "time": t}); t += 7
         out = self._match(net, pts)
-        seg = next(s for s in out["segments"] if "segment_id" in s)
+        # the way splits into per-block OSMLR segments at node 2; the
+        # stall sits on the 2->3 piece, so find the queued segment
+        seg = max((s for s in out["segments"] if "segment_id" in s),
+                  key=lambda s: s["queue_length"])
         assert seg["queue_length"] > 20
         sid = seg["segment_id"]
         assert seg["queue_length"] <= net.segment_length_m[sid]
@@ -194,27 +226,31 @@ class TestQueueLength:
             assert s["queue_length"] == 0
 
     def test_midsegment_slowdown_then_recovery_clears_queue(self, net):
-        # slow in the middle, fast at the end: queue resets to 0
+        # slow in the MIDDLE of the 2->3 block-segment (the way splits at
+        # node 2 now), fast again before its end: queue resets to 0
         pts, t = [], 1500000000
-        for la in np.linspace(14.5800, 14.5808, 5):
+        for la in np.linspace(14.5810, 14.58125, 4):
             pts.append({"lat": float(la), "lon": 121.0, "time": t}); t += 3
         for i in range(3):  # crawl mid-segment
-            pts.append({"lat": 14.58085 + i * 1.5e-5, "lon": 121.0,
+            pts.append({"lat": 14.5813 + i * 1.5e-5, "lon": 121.0,
                         "time": t}); t += 7
-        for la in np.linspace(14.5810, 14.5818, 5):
+        for la in np.linspace(14.5815, 14.5819, 5):
             pts.append({"lat": float(la), "lon": 121.0, "time": t}); t += 3
         out = self._match(net, pts)
         for s in out["segments"]:
             assert s["queue_length"] == 0
 
     def test_far_from_end_stall_reports_no_queue(self, net):
-        # stall early in the segment (>100 m from its end): the segment end
-        # was never observed, so no queue may be extrapolated
+        # stall early in a LONG segment (>100 m from its end): the
+        # segment end was never observed, so no queue may be
+        # extrapolated. Way 107 (nodes 8->10, ~222 m) has no interior
+        # junction, so it stays one segment after splitting.
         pts, t = [], 1500000000
-        for la in np.linspace(14.5800, 14.5805, 4):
-            pts.append({"lat": float(la), "lon": 121.0, "time": t}); t += 3
+        for la in np.linspace(14.5840, 14.5843, 4):
+            pts.append({"lat": float(la), "lon": 121.001, "time": t})
+            t += 3
         for i in range(4):
-            pts.append({"lat": 14.58052 + i * 1.5e-5, "lon": 121.0,
+            pts.append({"lat": 14.58432 + i * 1.5e-5, "lon": 121.001,
                         "time": t}); t += 7
         out = self._match(net, pts)
         for s in out["segments"]:

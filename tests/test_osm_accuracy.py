@@ -1,0 +1,61 @@
+"""Accuracy on a non-grid city through the REAL OSM import path
+(round-4 VERDICT #7).
+
+The grid city's axis-aligned one-edge-per-block layout is the easy case;
+this fixture (tools/osm_fixture.py) is a deterministic irregular town —
+curved multi-node ways, one-way residentials, primary diagonals, motorway
+ramps, service alleys — imported via graph/osm.py (way classification,
+junction-split OSMLR synthesis). Gates mirror ci.yml: >=99% on the
+complete-segment datastore stream (BASELINE.md north star), >=96% strict
+per-point attribution, and the determinism of the fixture itself.
+"""
+import io
+
+import numpy as np
+import pytest
+
+from reporter_tpu.graph.osm import network_from_osm_xml
+from reporter_tpu.matcher import MatchParams, SegmentMatcher
+from reporter_tpu.synth import generate_trace
+from reporter_tpu.tools.accuracy_cli import score
+from reporter_tpu.tools.osm_fixture import build_city_xml
+
+
+@pytest.fixture(scope="module")
+def osm_city():
+    return network_from_osm_xml(io.BytesIO(build_city_xml().encode()))
+
+
+def test_fixture_is_deterministic():
+    assert build_city_xml() == build_city_xml()
+
+
+def test_fixture_imports_realistically(osm_city):
+    net = osm_city
+    assert net.num_edges > 500
+    assert net.edge_internal.sum() > 0          # motorway_link ramps
+    assert (net.edge_segment_id < 0).sum() > 0  # service alleys
+    lens = np.array(list(net.segment_length_m.values()))
+    # junction-split OSMLR: block-scale segments, none beyond the cap +
+    # one trailing block
+    assert 100.0 < lens.mean() < 500.0
+    assert lens.max() < 1400.0
+
+
+def test_accuracy_gates_on_osm_city(osm_city):
+    net = osm_city
+    # turn penalty 500 mirrors the reference's own accuracy harness
+    # (reference: py/generate_test_trace.py:172)
+    matcher = SegmentMatcher(
+        net=net, params=MatchParams(turn_penalty_factor=500.0))
+    rng = np.random.default_rng(0)
+    traces = []
+    while len(traces) < 24:
+        tr = generate_trace(net, f"acc-{len(traces)}", rng, noise_m=4.0,
+                            min_route_edges=8)
+        if tr is not None:
+            traces.append(tr)
+    result = score(net, matcher, traces)
+    assert result["agreement"] >= 0.99, result
+    assert result["point_agreement"] >= 0.96, result
+    assert result["segments_emitted"] > 50, result

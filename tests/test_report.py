@@ -75,20 +75,24 @@ class TestHoldback:
     def test_trailing_segments_withheld(self):
         # trace ends at t=100; segment starting at 90 is within 15s holdback
         match = {"segments": [
-            seg(LV0_A, 0, 50, begin=0),
-            seg(LV0_B, 50, 90, begin=4),
+            seg(LV0_A, 0, 50, begin=0, endi=3),
+            seg(LV0_B, 50, 90, begin=4, endi=7),
             seg(LV0_C, 90, 100, begin=8),
         ]}
         out = report(match, trace_ending_at(100), 15, {0, 1}, {0, 1})
         ids = [r["id"] for r in out["datastore"]["reports"]]
         assert ids == [LV0_A]
-        assert out["shape_used"] == 4  # begin_shape_index of LV0_B
+        # the trim keeps the boundary-straddling probe: LV0_A's LAST
+        # point (end_shape_index 3), not LV0_B's first — the next window
+        # needs it to interpolate LV0_B's entry time (report.py)
+        assert out["shape_used"] == 3
 
     def test_shape_used_omitted_when_zero(self):
-        # reference quirk: `if shape_used:` drops index 0
+        # reference quirk: `if shape_used:` drops index 0 (here the
+        # straddling probe — the predecessor's last point — IS index 0)
         match = {"segments": [
-            seg(LV0_A, 0, 50, begin=0),
-            seg(LV0_B, 50, 80, begin=0),
+            seg(LV0_A, 0, 50, begin=0, endi=0),
+            seg(LV0_B, 50, 80, begin=1),
         ]}
         out = report(match, trace_ending_at(100), 15, {0, 1}, {0, 1})
         assert "shape_used" not in out

@@ -128,10 +128,15 @@ class TestWorkerCrashResume:
         state_path = str(tmp_path / "state.bin")
 
         def make_worker():
+            # levels 0,1,2 like tests/env.sh: the grid city's streets are
+            # mostly level 2, and honest complete-traversal reporting (no
+            # fabricated completes) means level-2 exclusion can zero out
+            # this short trace's reports
             return StreamWorker(
                 Formatter.from_config(fmt), inproc_submitter(service),
                 Anonymiser(TileSink(out), privacy=1, quantisation=3600,
                            source="t"),
+                reports="0,1,2", transitions="0,1,2",
                 flush_interval_s=1e9,
                 state=StateStore(state_path, interval_s=0.0))
 
