@@ -279,7 +279,7 @@ def prepare_batch(runtime, traces_points: Sequence[Sequence[dict]],
     # identically — matcher/hmm.py). The cast runs in native code
     # (F16C); numpy's f16 astype was the top host cost after batching.
     dist, route, gc = out["dist_m"], out["route_m"], out["gc_m"]
-    if _wire_f16() and _f16_safe_arrays(route, dist, gc):
+    if _wire_f16() and float(out["max_finite"][0]) <= WIRE_MAX_M:
         dist = runtime.to_f16(dist)
         route = runtime.to_f16(route)
         gc = runtime.to_f16(gc)

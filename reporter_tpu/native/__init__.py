@@ -30,7 +30,7 @@ _LIB_PATH = os.path.join(_DIR, "libreporter_host.so")
 # Must equal host_runtime.cpp's rt_abi_version(). The handshake in
 # _get_lib() turns a half-landed ABI change (library and binding updated
 # in different commits) into a loud numpy fallback instead of a segfault.
-ABI_VERSION = 7
+ABI_VERSION = 8
 _lib = None
 _build_lock = threading.Lock()
 _build_failed = False
@@ -132,7 +132,7 @@ def _get_lib() -> Optional[ctypes.CDLL]:
             ctypes.c_double, ctypes.c_double, ctypes.c_double,
             ctypes.c_int32,
             c_i32p, c_f32p, c_f32p, c_f32p, c_f32p, c_i32p, c_i32p, c_i32p,
-            c_f32p]
+            c_f32p, c_f32p]
         i64ref = ctypes.POINTER(ctypes.c_int64)
         lib.rt_tile_counts.restype = ctypes.c_int32
         lib.rt_tile_counts.argtypes = [
@@ -337,6 +337,10 @@ class NativeRuntime:
             "kept_idx": np.full((rows, T), -1, np.int32),
             "num_kept": np.zeros(rows, np.int32),
             "dwell": np.zeros(rows, np.float32),
+            # max finite distance written anywhere (dist/gc/route) — the
+            # wire-dtype decision reads this scalar instead of re-scanning
+            # the tensors
+            "max_finite": np.zeros(1, np.float32),
         }
         lat0, lon0 = self.net.projection_anchor()
         self._lib.rt_prepare_batch(
@@ -349,7 +353,7 @@ class NativeRuntime:
             float(turn_penalty_factor), int(n_threads),
             out["edge_ids"], out["dist_m"], out["offset_m"],
             out["route_m"], out["gc_m"], out["case"], out["kept_idx"],
-            out["num_kept"], out["dwell"])
+            out["num_kept"], out["dwell"], out["max_finite"])
         return out
 
     def to_f16(self, arr: np.ndarray) -> np.ndarray:

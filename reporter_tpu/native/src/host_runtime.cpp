@@ -373,7 +373,7 @@ extern "C" {
 // numpy path loudly instead of calling through a stale signature. BUMP
 // THIS on ANY change to the signatures below, in the same commit as the
 // Python-side constant.
-int32_t rt_abi_version(void) { return 7; }
+int32_t rt_abi_version(void) { return 8; }
 
 void* rt_graph_create(int64_t n_nodes, int64_t n_edges,
                       const double* node_x, const double* node_y,
@@ -494,7 +494,7 @@ void rt_prepare_batch(void* handle, int64_t n_traces, const int64_t* pt_off,
                       int32_t* out_edge, float* out_dist, float* out_off,
                       float* out_route, float* out_gc, int32_t* out_case,
                       int32_t* out_kept, int32_t* out_num_kept,
-                      float* out_dwell) {
+                      float* out_dwell, float* out_max_finite) {
   auto* g = static_cast<Graph*>(handle);
   const double coslat0 = std::cos(lat0 * kRadPerDeg);
   const int64_t TK = static_cast<int64_t>(T) * K;
@@ -503,12 +503,25 @@ void rt_prepare_batch(void* handle, int64_t n_traces, const int64_t* pt_off,
   // the seq mesh axis with no host-side pad copy (parallel/sharded.py)
   const int64_t TKK = static_cast<int64_t>(T) * K * K;
 
+  // running max of every finite distance written (candidate dists, gc,
+  // reachable route entries) — the wire-dtype decision (f16 iff the max
+  // fits) used to re-scan the 10x-larger tensors in numpy
+  std::atomic<float> max_finite{0.0f};
+  auto bump_max = [&max_finite](float v) {
+    float cur = max_finite.load(std::memory_order_relaxed);
+    while (v > cur &&
+           !max_finite.compare_exchange_weak(cur, v,
+                                             std::memory_order_relaxed)) {
+    }
+  };
+
   auto prepare_one = [&](int64_t b, CandScratch& scratch,
                          std::vector<int32_t>& edge_raw,
                          std::vector<float>& dist_raw,
                          std::vector<float>& off_raw,
                          std::vector<int32_t>& kept,
                          std::vector<double>& gc_kept) {
+    float local_max = 0.0f;
     const int64_t p0 = pt_off[b], p1 = pt_off[b + 1];
     const int64_t n_raw = p1 - p0;
     int32_t* edge_b = out_edge + b * TK;
@@ -591,6 +604,10 @@ void rt_prepare_batch(void* handle, int64_t n_traces, const int64_t* pt_off,
       std::memcpy(dist_b + t * K, dist_raw.data() + p * K,
                   K * sizeof(float));
       std::memcpy(off_b + t * K, off_raw.data() + p * K, K * sizeof(float));
+      for (int32_t q = 0; q < K; ++q) {
+        const float d = dist_b[t * K + q];
+        if (d < kUnreachable / 2 && d > local_max) local_max = d;
+      }
       kept_b[t] = static_cast<int32_t>(p);
       if (t > 0) {
         const int64_t pp = kept[t - 1];
@@ -620,6 +637,14 @@ void rt_prepare_batch(void* handle, int64_t n_traces, const int64_t* pt_off,
                  min_bound, backward_tol, time_factor, min_time_bound,
                  turn_penalty_factor, route_b + static_cast<int64_t>(t) * K * K);
     }
+    for (int32_t t = 0; t + 1 < n; ++t) {
+      if (gc_b[t] > local_max) local_max = gc_b[t];
+      const float* row = route_b + static_cast<int64_t>(t) * K * K;
+      for (int32_t q = 0; q < K * K; ++q)
+        if (row[q] < kUnreachable / 2 && row[q] > local_max)
+          local_max = row[q];
+    }
+    bump_max(local_max);
   };
 
   int32_t workers = n_threads > 0
@@ -635,6 +660,7 @@ void rt_prepare_batch(void* handle, int64_t n_traces, const int64_t* pt_off,
     std::vector<double> gc_kept;
     for (int64_t b = 0; b < n_traces; ++b)
       prepare_one(b, scratch, edge_raw, dist_raw, off_raw, kept, gc_kept);
+    *out_max_finite = max_finite.load();
     return;
   }
   std::atomic<int64_t> next{0};
@@ -654,6 +680,7 @@ void rt_prepare_batch(void* handle, int64_t n_traces, const int64_t* pt_off,
     });
   }
   for (auto& th : pool) th.join();
+  *out_max_finite = max_finite.load();
 }
 
 // f32 -> f16 (IEEE half) bulk conversion for the wire tensors

@@ -150,6 +150,37 @@ def test_f16_cast_bit_identical_to_numpy(matcher):
                                   got.view(np.uint16))
 
 
+def test_prepare_batch_wire_dtype_decision(matcher, traces):
+    """The batch ships f16 when every finite distance fits the wire
+    (decided from the C++-computed max_finite scalar), f32 otherwise —
+    same policy as pack_batches (tests/test_matcher.py)."""
+    from reporter_tpu.matcher.hmm import WIRE_MAX_M
+    pts = [tr.points for tr in traces[:4]]
+    batch = prepare_batch(matcher.runtime, pts, matcher.params, 64)
+    assert batch.route_m.dtype == np.float16  # city-scale distances fit
+    assert float(batch.prep["max_finite"][0]) <= WIRE_MAX_M
+
+    # a long straight road: consecutive probes ~4.5 km apart (under the
+    # 5 km breakage override) produce finite route distances beyond the
+    # f16-safe ceiling -> the whole batch falls back to the f32 wire
+    from reporter_tpu.matcher import MatchParams, SegmentMatcher
+    from tests.test_knobs import _net_from_meters, _pts_from_meters
+    road = _net_from_meters(
+        [(0.0, 0.0), (4500.0, 0.0), (9000.0, 0.0)], [(0, 1), (1, 2)])
+    m2 = SegmentMatcher(net=road,
+                        params=MatchParams(breakage_distance=5000.0))
+    far = _pts_from_meters([(10.0, 1.0, 0.0), (4510.0, -1.0, 300.0),
+                            (8990.0, 1.0, 600.0)])
+    # both the serial and the threaded C++ paths must report the max
+    # (a multi-trace batch with n_threads>1 exercises the join path,
+    # where an unwritten out_max_finite would silently force f16)
+    for n_threads in (1, 2):
+        b2 = prepare_batch(m2.runtime, [far, far], m2.params, 16,
+                           n_threads=n_threads)
+        assert float(b2.prep["max_finite"][0]) > WIRE_MAX_M, n_threads
+        assert b2.route_m.dtype == np.float32, n_threads
+
+
 def test_match_options_split_batches(matcher, traces):
     # per-trace match_options that change prep params must not share a
     # native prep call; results still line up with per-trace fallback
