@@ -75,10 +75,60 @@ struct Graph {
   struct DistTime {
     float d, t;
   };
+  // open-addressing node->DistTime map (linear probing, pow2 capacity,
+  // key -1 = empty; node ids are >= 0). The K*K admissibility lookups per
+  // step — millions per batch — were bound on std::unordered_map's
+  // bucket-chain finds; a flat probe sequence is one cache line most of
+  // the time.
+  struct FlatMap {
+    std::vector<int32_t> keys;
+    std::vector<DistTime> vals;
+    size_t mask = 0, count = 0;
+
+    explicit FlatMap(size_t cap_pow2 = 16) { init(cap_pow2); }
+
+    void init(size_t cap_pow2) {
+      keys.assign(cap_pow2, -1);
+      vals.resize(cap_pow2);
+      mask = cap_pow2 - 1;
+      count = 0;
+    }
+
+    static size_t slot_hash(int32_t k) {
+      return static_cast<size_t>(static_cast<uint32_t>(k) * 2654435761u);
+    }
+
+    const DistTime* find(int32_t k) const {
+      size_t i = slot_hash(k) & mask;
+      for (;;) {
+        if (keys[i] == k) return &vals[i];
+        if (keys[i] == -1) return nullptr;
+        i = (i + 1) & mask;
+      }
+    }
+
+    DistTime& slot_for(int32_t k) {
+      size_t i = slot_hash(k) & mask;
+      while (keys[i] != -1 && keys[i] != k) i = (i + 1) & mask;
+      if (keys[i] == -1) {
+        keys[i] = k;
+        ++count;
+      }
+      return vals[i];
+    }
+
+    DistTime& insert(int32_t k) {
+      if ((count + 1) * 10 >= (mask + 1) * 7) {  // load factor 0.7
+        FlatMap bigger((mask + 1) * 2);
+        for (size_t i = 0; i <= mask; ++i)
+          if (keys[i] != -1) bigger.slot_for(keys[i]) = vals[i];
+        *this = std::move(bigger);
+      }
+      return slot_for(k);
+    }
+  };
   struct CacheStripe {
-    std::unordered_map<
-        int32_t, std::pair<float, std::unordered_map<int32_t, DistTime>>>
-        map;
+    std::unordered_map<int32_t, std::pair<float, FlatMap>> map;
     std::mutex mu;
   };
   std::array<CacheStripe, kStripes> route_stripes;
@@ -131,32 +181,36 @@ struct Graph {
   // entries. Caller must hold stripe_for(src).mu for the whole call AND
   // for as long as it reads the returned map (an extension to a larger
   // bound move-assigns the mapped value, invalidating concurrent reads).
-  const std::unordered_map<int32_t, DistTime>& dists_from(int32_t src,
-                                                          float bound) {
+  const FlatMap& dists_from(int32_t src, float bound) {
     auto& route_cache = stripe_for(src).map;
     auto it = route_cache.find(src);
     if (it != route_cache.end() && it->second.first >= bound)
       return it->second.second;
-    std::unordered_map<int32_t, DistTime> dist;
+    // pre-size from the entry being extended (if any): a bound extension
+    // revisits at least as many nodes as the cached search found
+    size_t cap = 16;
+    if (it != route_cache.end())
+      while (cap * 7 <= it->second.second.count * 10) cap *= 2;
+    FlatMap dist(cap);
     using QE = std::pair<float, int32_t>;
     std::priority_queue<QE, std::vector<QE>, std::greater<QE>> heap;
-    dist[src] = {0.0f, 0.0f};
+    dist.insert(src) = {0.0f, 0.0f};
     heap.push({0.0f, src});
     while (!heap.empty()) {
       auto [d, u] = heap.top();
       heap.pop();
-      auto du = dist.find(u);
-      if (du != dist.end() && d > du->second.d) continue;
+      const DistTime* du = dist.find(u);
+      if (du != nullptr && d > du->d) continue;
       if (d > bound) break;
-      const float tu = dist[u].t;
+      const float tu = du != nullptr ? du->t : 0.0f;
       for (int64_t k = csr_off[u]; k < csr_off[u + 1]; ++k) {
         int32_t e = csr_edge[k];
         int32_t v = edge_end[e];
         float nd = d + edge_len[e];
         if (nd > bound) continue;
-        auto dv = dist.find(v);
-        if (dv == dist.end() || nd < dv->second.d) {
-          dist[v] = {nd, tu + edge_secs(e, edge_len[e])};
+        const DistTime* dv = dist.find(v);
+        if (dv == nullptr || nd < dv->d) {
+          dist.insert(v) = {nd, tu + edge_secs(e, edge_len[e])};
           heap.push({nd, v});
         }
       }
@@ -321,22 +375,22 @@ void route_step(Graph* g, const int32_t* ea_row, const float* oa_row,
         row[j] = kUnreachable;
         continue;
       }
-      auto it = dist.find(g->edge_start[eb]);
+      const Graph::DistTime* it = dist.find(g->edge_start[eb]);
       // reachable only if the whole route fits inside the bound, matching
       // the python fallback's max_dist semantics (graph/route.py)
-      if (it == dist.end() || via + it->second.d > bound) {
+      if (it == nullptr || via + it->d > bound) {
         row[j] = kUnreachable;
         continue;
       }
       if (time_cap >= 0) {
         const float secs = g->edge_secs(ea, remaining) +
-                           g->edge_secs(eb, ob) + it->second.t;
+                           g->edge_secs(eb, ob) + it->t;
         if (secs > time_cap) {
           row[j] = kUnreachable;
           continue;
         }
       }
-      float d = via + it->second.d;
+      float d = via + it->d;
       if (turn_penalty_factor > 0) {
         const float cos_th =
             g->head_x[ea] * g->head_x[eb] + g->head_y[ea] * g->head_y[eb];
