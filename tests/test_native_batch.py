@@ -181,6 +181,35 @@ def test_prepare_batch_wire_dtype_decision(matcher, traces):
         assert b2.route_m.dtype == np.float32, n_threads
 
 
+def test_all_decode_backends_accept_t_row_route(matcher, traces):
+    """Native prep ships route/gc with T time rows (dead trailing step
+    for seq sharding); every decode backend must shed it identically
+    (matcher/hmm.py trim_time_pad)."""
+    import numpy as np
+
+    from reporter_tpu.ops import viterbi_assoc_batch, viterbi_pallas_batch
+    from reporter_tpu.matcher.hmm import viterbi_decode_batch
+
+    batch = prepare_batch(matcher.runtime,
+                          [tr.points for tr in traces[:6]],
+                          matcher.params, 64)
+    assert batch.route_m.shape[1] == 64  # T rows, not T-1
+    sigma, beta = np.float32(4.07), np.float32(3.0)
+    args = (batch.dist_m, batch.valid, batch.route_m, batch.gc_m,
+            batch.case, sigma, beta)
+    p_scan, _ = viterbi_decode_batch(*args)
+    p_assoc, _ = viterbi_assoc_batch(*args)
+    p_pallas, _ = viterbi_pallas_batch(*args, interpret=True)
+    # identical decoded paths over the kept prefixes (ties can only flip
+    # under different f32 orderings; these backends agree on this data)
+    for b, tr in enumerate(batch.traces):
+        nk = tr.num_kept
+        np.testing.assert_array_equal(np.asarray(p_scan)[b, :nk],
+                                      np.asarray(p_assoc)[b, :nk])
+        np.testing.assert_array_equal(np.asarray(p_scan)[b, :nk],
+                                      np.asarray(p_pallas)[b, :nk])
+
+
 def test_match_options_split_batches(matcher, traces):
     # per-trace match_options that change prep params must not share a
     # native prep call; results still line up with per-trace fallback
