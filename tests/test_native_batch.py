@@ -185,7 +185,6 @@ def test_all_decode_backends_accept_t_row_route(matcher, traces):
     """Native prep ships route/gc with T time rows (dead trailing step
     for seq sharding); every decode backend must shed it identically
     (matcher/hmm.py trim_time_pad)."""
-    import numpy as np
 
     from reporter_tpu.ops import viterbi_assoc_batch, viterbi_pallas_batch
     from reporter_tpu.matcher.hmm import viterbi_decode_batch
