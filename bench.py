@@ -39,7 +39,8 @@ kernel claims trace to a committed artifact.
 Env knobs: BENCH_TRACES (default 512), BENCH_BASELINE_TRACES (default
 128), BENCH_T (bucket, default 64), BENCH_K (default 8), BENCH_REPEATS
 (default 5), BENCH_BASELINE_REPEATS (default 3), BENCH_PALLAS
-(default: auto — on when the platform is tpu),
+(default: auto — on when the platform is tpu), BENCH_PROFILE (a
+directory: record one jax.profiler device trace of a batched pass),
 REPORTER_TPU_PROBE_TIMEOUT_S / _TRIES (probe patience).
 """
 import json
@@ -162,6 +163,18 @@ def main():
 
     # -- batched leg: the production path end-to-end ----------------------
     matcher.match_many(reqs[:8])  # warmup: compile the bucket shapes
+    profile_dir = os.environ.get("BENCH_PROFILE")
+    if profile_dir:
+        # opt-in device profile of one batched pass (TensorBoard/Perfetto
+        # viewable via jax.profiler — utils/metrics.device_trace); a
+        # profiler failure must not cost the artifact
+        try:
+            from reporter_tpu.utils.metrics import device_trace
+            with device_trace(profile_dir):
+                matcher.match_many(reqs)
+        except Exception as e:
+            print(f"profile pass failed (continuing): {e}",
+                  file=sys.stderr)
     best, stages = _time_batched_leg(matcher, reqs, make_report, repeats)
     batched_tps = n_traces / best
 
