@@ -210,6 +210,19 @@ def assemble_segments(net: RoadNetwork, prepared, path: np.ndarray,
         prev_ok = True
     flush_chain(final=True)
 
+    # attribute the jitter points the HMM excluded: index spans cover
+    # every input point from the first matched probe onward (leading
+    # candidate-less probes — off-network — stay unattributed, rightly).
+    # Gap points between runs join the FOLLOWING run (keeping the
+    # preceding run's end at its last kept probe — the shape_used trim
+    # anchor), and a verifiably-jitter trailing tail joins the final
+    # run. Without this, every dropped point between runs reads as
+    # unmatched to consumers walking the spans.
+    for prev, cur in zip(segments, segments[1:]):
+        cur["begin_shape_index"] = prev["end_shape_index"] + 1
+    if segments and trailing_dwell_s > 0.0:
+        segments[-1]["end_shape_index"] = int(prepared.num_raw) - 1
+
     return {"segments": segments, "mode": mode}
 
 

@@ -6,9 +6,10 @@ fixed-shape and branch-free (SURVEY.md §7 "Hard parts: raggedness"):
 1. **Point filtering.** Probe points closer than ``interpolation_distance``
    to the last kept point (GPS jitter while slow/stopped) and points with no
    candidate edges are *excluded* from the HMM; the Viterbi runs over the
-   kept subsequence only, and excluded points are attributed to the decoded
-   runs afterwards. This mirrors Meili's interpolation behavior and is what
-   keeps backward-jitter from reading as a u-turn.
+   kept subsequence only, and excluded jitter points are attributed to the
+   decoded runs afterwards (leading candidate-less probes — off-network —
+   stay unattributed). This mirrors Meili's interpolation behavior and is
+   what keeps backward-jitter from reading as a u-turn.
 
 2. **Bucketed padding.** Kept subsequences are padded to the smallest bucket
    in ``LENGTH_BUCKETS`` so XLA compiles a handful of shapes, not thousands.

@@ -1055,6 +1055,15 @@ int64_t rt_assemble_batch(
     }
     flush_chain(true);
 
+    // attribute HMM-excluded points: gap points between runs join the
+    // FOLLOWING run, and a verifiably-jitter trailing tail joins the
+    // final run (matcher/assemble.py has the contract rationale)
+    for (size_t ri = 1; ri < runs.size(); ++ri)
+      runs[ri].first_idx = runs[ri - 1].last_idx + 1;
+    if (!runs.empty() && trailing_dwell > 0.0)
+      runs.back().last_idx =
+          static_cast<int32_t>(pt_off[b + 1] - pt_off[b]) - 1;
+
     // write this trace's runs to the flat outputs
     if (r_total + static_cast<int64_t>(runs.size()) > cap) return -1;
     std::fesetround(FE_TONEAREST);

@@ -6,7 +6,7 @@ this fixture (tools/osm_fixture.py) is a deterministic irregular town —
 curved multi-node ways, one-way residentials, primary diagonals, motorway
 ramps, service alleys — imported via graph/osm.py (way classification,
 junction-split OSMLR synthesis). Gates mirror ci.yml: >=99% on the
-complete-segment datastore stream (BASELINE.md north star), >=96% strict
+complete-segment datastore stream (BASELINE.md north star), >=97.5% strict
 per-point attribution, and the determinism of the fixture itself.
 """
 import io
@@ -57,5 +57,5 @@ def test_accuracy_gates_on_osm_city(osm_city):
             traces.append(tr)
     result = score(net, matcher, traces)
     assert result["agreement"] >= 0.99, result
-    assert result["point_agreement"] >= 0.96, result
+    assert result["point_agreement"] >= 0.975, result
     assert result["segments_emitted"] > 50, result
