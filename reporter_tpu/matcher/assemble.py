@@ -173,8 +173,8 @@ def assemble_segments(net: RoadNetwork, prepared, path: np.ndarray,
                                      0.0))
 
     # walk chains of kept points, split at RESTART boundaries; excluded
-    # points (jitter/no-candidate) fall inside the surrounding runs' index
-    # spans and need no explicit handling here
+    # points BETWEEN runs are attributed to spans by the fix-up after the
+    # walk (dropped points inside one run's span need nothing)
     chain: List[tuple] = []  # (orig_idx, edge, seg_id, seg_pos, time, cum, internal)
 
     def flush_chain(final: bool = False):
@@ -210,16 +210,29 @@ def assemble_segments(net: RoadNetwork, prepared, path: np.ndarray,
         prev_ok = True
     flush_chain(final=True)
 
-    # attribute the jitter points the HMM excluded: index spans cover
-    # every input point from the first matched probe onward (leading
-    # candidate-less probes — off-network — stay unattributed, rightly).
-    # Gap points between runs join the FOLLOWING run (keeping the
-    # preceding run's end at its last kept probe — the shape_used trim
-    # anchor), and a verifiably-jitter trailing tail joins the final
-    # run. Without this, every dropped point between runs reads as
-    # unmatched to consumers walking the spans.
+    # attribute the jitter points the HMM excluded: gap points between
+    # runs join the FOLLOWING run (keeping the preceding run's end at
+    # its last kept probe — the shape_used trim anchor), and a
+    # verifiably-jitter trailing tail joins the final run. Candidate-
+    # less probes — off-network — stay unattributed wherever they occur:
+    # leading ones, and any in a between-run gap together with the
+    # jitter points BEFORE them (spans are contiguous and cannot
+    # hole-punch). Without this fix-up, every dropped point between
+    # runs reads as unmatched to consumers walking the spans.
+    hc = getattr(prepared, "has_cands", None)
     for prev, cur in zip(segments, segments[1:]):
-        cur["begin_shape_index"] = prev["end_shape_index"] + 1
+        lo = prev["end_shape_index"] + 1
+        hi = cur["begin_shape_index"]
+        start = lo
+        if hc is not None:
+            # candidate-less (off-network) gap points stay unattributed;
+            # spans are contiguous, so attribution reaches back only to
+            # just after the last off-network point in the gap
+            for j in range(hi - 1, lo - 1, -1):
+                if not hc[j]:
+                    start = j + 1
+                    break
+        cur["begin_shape_index"] = start
     if segments and trailing_dwell_s > 0.0:
         segments[-1]["end_shape_index"] = int(prepared.num_raw) - 1
 

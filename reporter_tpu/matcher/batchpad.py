@@ -7,9 +7,10 @@ fixed-shape and branch-free (SURVEY.md §7 "Hard parts: raggedness"):
    to the last kept point (GPS jitter while slow/stopped) and points with no
    candidate edges are *excluded* from the HMM; the Viterbi runs over the
    kept subsequence only, and excluded jitter points are attributed to the
-   decoded runs afterwards (leading candidate-less probes — off-network —
-   stay unattributed). This mirrors Meili's interpolation behavior and is
-   what keeps backward-jitter from reading as a u-turn.
+   decoded runs afterwards (candidate-less probes — off-network — stay
+   unattributed wherever they occur; see assemble.py's span fix-up). This
+   mirrors Meili's interpolation behavior and is what keeps
+   backward-jitter from reading as a u-turn.
 
 2. **Bucketed padding.** Kept subsequences are padded to the smallest bucket
    in ``LENGTH_BUCKETS`` so XLA compiles a handful of shapes, not thousands.
@@ -59,6 +60,9 @@ class PreparedTrace:
     # seconds the raw tail verifiably dwelt at the last kept point (jitter
     # drops only; 0 when the tail was off-network or bucket-truncated)
     trailing_jitter_dwell_s: float = 0.0
+    # (num_raw,) u8/bool: raw point had any candidate edge; None on
+    # hand-built preps (assembler then treats every drop as jitter)
+    has_cands: "np.ndarray | None" = None
 
     @property
     def T(self) -> int:
@@ -184,7 +188,8 @@ def prepare_trace(net: RoadNetwork, grid: SpatialGrid | None,
                          times=times, edge_ids=edge_ids, dist_m=dist,
                          offset_m=offset, route_m=route_p, gc_m=gc_p,
                          case=case,
-                         trailing_jitter_dwell_s=trailing_jitter_dwell_s)
+                         trailing_jitter_dwell_s=trailing_jitter_dwell_s,
+                         has_cands=np.asarray(has_cands))
 
 
 @dataclass
@@ -273,7 +278,8 @@ def prepare_batch(runtime, traces_points: Sequence[Sequence[dict]],
             # (T-1, ...) contract — a contiguous slice, no copy
             route_m=out["route_m"][b, :max(T - 1, 0)],
             gc_m=out["gc_m"][b, :max(T - 1, 0)], case=out["case"][b],
-            trailing_jitter_dwell_s=float(out["dwell"][b])))
+            trailing_jitter_dwell_s=float(out["dwell"][b]),
+            has_cands=out["has_cands"][pt_off[b]:pt_off[b + 1]]))
 
     # wire dtype: one vectorised decision + cast for the whole batch
     # (sentinels overflow f16 to +inf, which device scoring treats

@@ -30,7 +30,7 @@ _LIB_PATH = os.path.join(_DIR, "libreporter_host.so")
 # Must equal host_runtime.cpp's rt_abi_version(). The handshake in
 # _get_lib() turns a half-landed ABI change (library and binding updated
 # in different commits) into a loud numpy fallback instead of a segfault.
-ABI_VERSION = 8
+ABI_VERSION = 9
 _lib = None
 _build_lock = threading.Lock()
 _build_failed = False
@@ -118,7 +118,7 @@ def _get_lib() -> Optional[ctypes.CDLL]:
         lib.rt_assemble_batch.argtypes = [
             ctypes.c_void_p, ctypes.c_int64, ctypes.c_int32, ctypes.c_int32,
             c_i32p, c_i32p, c_f32p, c_f32p, c_i32p, c_i32p, c_i32p, c_f32p,
-            c_i64p, c_f64p,
+            c_i64p, c_f64p, c_u8p,
             c_i64p, c_f32p, c_u8p, c_i64p, c_f64p, ctypes.c_int64,
             ctypes.c_double, ctypes.c_double, ctypes.c_double,
             ctypes.c_double, ctypes.c_int64,
@@ -132,7 +132,7 @@ def _get_lib() -> Optional[ctypes.CDLL]:
             ctypes.c_double, ctypes.c_double, ctypes.c_double,
             ctypes.c_int32,
             c_i32p, c_f32p, c_f32p, c_f32p, c_f32p, c_i32p, c_i32p, c_i32p,
-            c_f32p, c_f32p]
+            c_f32p, c_u8p, c_f32p]
         i64ref = ctypes.POINTER(ctypes.c_int64)
         lib.rt_tile_counts.restype = ctypes.c_int32
         lib.rt_tile_counts.argtypes = [
@@ -337,6 +337,10 @@ class NativeRuntime:
             "kept_idx": np.full((rows, T), -1, np.int32),
             "num_kept": np.zeros(rows, np.int32),
             "dwell": np.zeros(rows, np.float32),
+            # per RAW point: had any candidate edge (flat over pt_off) —
+            # distinguishes jitter drops from off-network drops in the
+            # assembler's span attribution
+            "has_cands": np.zeros(max(int(pt_off[-1]), 1), np.uint8),
             # max finite distance written anywhere (dist/gc/route) — the
             # wire-dtype decision reads this scalar instead of re-scanning
             # the tensors
@@ -353,7 +357,8 @@ class NativeRuntime:
             float(turn_penalty_factor), int(n_threads),
             out["edge_ids"], out["dist_m"], out["offset_m"],
             out["route_m"], out["gc_m"], out["case"], out["kept_idx"],
-            out["num_kept"], out["dwell"], out["max_finite"])
+            out["num_kept"], out["dwell"], out["has_cands"],
+            out["max_finite"])
         return out
 
     def to_f16(self, arr: np.ndarray) -> np.ndarray:
@@ -423,14 +428,19 @@ class NativeRuntime:
             "way_off": np.empty(cap + 1, np.int64),
             "ways": np.empty(cap, np.int64),
         }
+        pt_off = np.ascontiguousarray(pt_off, dtype=np.int64)
+        has_cands = prep.get("has_cands")
+        if has_cands is None:  # hand-built preps: treat all drops as jitter
+            has_cands = np.ones(max(int(pt_off[-1]), 1), np.uint8)
         n = self._lib.rt_assemble_batch(
             self._handle, B, T, K, path,
             prep["edge_ids"][:B], prep["offset_m"][:B],
             prep["route_m"][:B], prep["case"][:B], prep["kept_idx"][:B],
             np.ascontiguousarray(num_kept, dtype=np.int32),
             prep["dwell"][:B],
-            np.ascontiguousarray(pt_off, dtype=np.int64),
+            pt_off,
             np.ascontiguousarray(times, dtype=np.float64),
+            np.ascontiguousarray(has_cands, dtype=np.uint8),
             cols["edge_seg_id"], cols["edge_seg_off"],
             cols["edge_internal"], cols["seg_ids"], cols["seg_lens"],
             len(cols["seg_ids"]),

@@ -427,7 +427,7 @@ extern "C" {
 // numpy path loudly instead of calling through a stale signature. BUMP
 // THIS on ANY change to the signatures below, in the same commit as the
 // Python-side constant.
-int32_t rt_abi_version(void) { return 8; }
+int32_t rt_abi_version(void) { return 9; }
 
 void* rt_graph_create(int64_t n_nodes, int64_t n_edges,
                       const double* node_x, const double* node_y,
@@ -548,7 +548,8 @@ void rt_prepare_batch(void* handle, int64_t n_traces, const int64_t* pt_off,
                       int32_t* out_edge, float* out_dist, float* out_off,
                       float* out_route, float* out_gc, int32_t* out_case,
                       int32_t* out_kept, int32_t* out_num_kept,
-                      float* out_dwell, float* out_max_finite) {
+                      float* out_dwell, uint8_t* out_has_cands,
+                      float* out_max_finite) {
   auto* g = static_cast<Graph*>(handle);
   const double coslat0 = std::cos(lat0 * kRadPerDeg);
   const int64_t TK = static_cast<int64_t>(T) * K;
@@ -611,6 +612,7 @@ void rt_prepare_batch(void* handle, int64_t n_traces, const int64_t* pt_off,
           has = true;
           break;
         }
+      out_has_cands[p0 + p] = has ? 1 : 0;
       if (!has) continue;
       if (!kept.empty()) {
         const int64_t lk = kept.back();
@@ -836,7 +838,7 @@ int64_t rt_assemble_batch(
     const int32_t* edge_ids, const float* offset_m, const float* route_m,
     const int32_t* case_codes, const int32_t* kept_idx,
     const int32_t* num_kept, const float* dwell, const int64_t* pt_off,
-    const double* times, const int64_t* edge_seg_id,
+    const double* times, const uint8_t* has_cands, const int64_t* edge_seg_id,
     const float* edge_seg_off, const uint8_t* edge_internal,
     const int64_t* seg_ids_sorted, const double* seg_lens_sorted,
     int64_t n_segs, double queue_threshold_kph,
@@ -1055,11 +1057,23 @@ int64_t rt_assemble_batch(
     }
     flush_chain(true);
 
-    // attribute HMM-excluded points: gap points between runs join the
-    // FOLLOWING run, and a verifiably-jitter trailing tail joins the
-    // final run (matcher/assemble.py has the contract rationale)
-    for (size_t ri = 1; ri < runs.size(); ++ri)
-      runs[ri].first_idx = runs[ri - 1].last_idx + 1;
+    // attribute HMM-excluded points: jitter gap points between runs
+    // join the FOLLOWING run — but only back to the last candidate-less
+    // (off-network) point, which stays unattributed along with anything
+    // before it (spans are contiguous ranges and cannot hole-punch) —
+    // and a verifiably-jitter trailing tail joins the final run
+    // (matcher/assemble.py has the contract rationale)
+    for (size_t ri = 1; ri < runs.size(); ++ri) {
+      const int32_t lo = runs[ri - 1].last_idx + 1;
+      const int32_t hi = runs[ri].first_idx;
+      int32_t start = lo;
+      for (int32_t j = hi - 1; j >= lo; --j)
+        if (!has_cands[pt_off[b] + j]) {
+          start = j + 1;
+          break;
+        }
+      runs[ri].first_idx = start;
+    }
     if (!runs.empty() && trailing_dwell > 0.0)
       runs.back().last_idx =
           static_cast<int32_t>(pt_off[b + 1] - pt_off[b]) - 1;

@@ -9,9 +9,12 @@ Three implementations of the batched Viterbi decode, one contract:
           work and launches on TPU hardware
 
 ``decode_batch`` picks per call: honours REPORTER_TPU_DECODE
-(scan|assoc|pallas) when set; otherwise assoc — the only backend that is
-both log-depth and seq-shardable, and the one the recorded benchmarks
-(BENCH_r*.json, produced by bench.py) measure. pallas stays opt-in until
+(scan|assoc|pallas) when set; otherwise the default is platform-aware —
+assoc on accelerators and device meshes (the only backend that is both
+log-depth and seq-shardable), scan on a lone CPU device where assoc's
+O(K^3) work is a measured ~4x decode loss and the T-step dependence
+chain costs nothing. bench.py records whichever default its platform
+resolves (the artifact's ``decode=`` field). pallas stays opt-in until
 a recorded run shows it winning on hardware.
 """
 import os
@@ -35,6 +38,14 @@ def decode_backend(T: int, K: int) -> str:
         return "assoc"  # bucket too large for the fused kernel's VMEM
     if forced in ("scan", "assoc", "pallas"):
         return forced
+    # default is platform-aware: assoc's max-plus matmuls buy log-depth
+    # and seq-shardability at O(K^3) work — the right trade on an
+    # accelerator or a device mesh, and a 4x throughput LOSS on a lone
+    # CPU device where the T-step dependence chain costs nothing
+    # (measured: 512 traces decode ~59 ms scan vs ~244 ms assoc on one
+    # CPU core). Single-device CPU -> scan; everything else -> assoc.
+    if jax.default_backend() == "cpu" and len(jax.local_devices()) == 1:
+        return "scan"
     return "assoc"
 
 

@@ -172,6 +172,46 @@ def test_turn_penalty_does_not_distort_times(use_native):
 
 
 @pytest.mark.parametrize("use_native", BACKENDS)
+def test_offnetwork_gap_points_stay_unattributed(use_native):
+    """Mid-trace candidate-less probes (vehicle off the mapped network,
+    e.g. a parking lot) must NOT be folded into any run's index span;
+    jitter-dropped points in the same gap after the detour may join the
+    following run."""
+    if use_native and not native.available():
+        pytest.skip("native toolchain unavailable")
+    road = _net_from_meters([(0.0, 0.0), (400.0, 0.0), (800.0, 0.0)],
+                            [(0, 1), (1, 2)])
+    pts = []
+    xs_on = [(230, 0), (275, 1), (320, -1), (365, 0)]  # on segment 0
+    for i, (x, y) in enumerate(xs_on):
+        pts.append((float(x), float(y), 3.0 * i))
+    # off-network detour ACROSS the segment boundary at x=400: 3 probes
+    # ~100 m south of the road (outside the 50 m search radius -> no
+    # candidates), so the runs on segment 0 and segment 1 have a gap
+    # between their spans
+    for j, x in enumerate((385, 400, 415)):
+        pts.append((float(x), -100.0, 12.0 + 3.0 * j))
+    for j, (x, y) in enumerate([(440, 0), (485, 1), (530, -1), (575, 0),
+                                (620, 1)]):
+        pts.append((float(x), float(y), 21.0 + 3.0 * j))
+    m = SegmentMatcher(net=road, use_native=use_native,
+                       params=MatchParams())
+    match = m.match_many([_req(_pts_from_meters(pts))])[0]
+    spans = {s.get("segment_id"):
+             (s["begin_shape_index"], s["end_shape_index"])
+             for s in match["segments"]}
+    assert 0 in spans and 1 in spans, match["segments"]
+    covered = set()
+    for b, e in spans.values():
+        covered.update(range(b, e + 1))
+    # the three off-network probes (indices 4, 5, 6) stay unattributed
+    assert not covered & {4, 5, 6}, sorted(covered)
+    # every on-network probe is covered
+    assert {0, 1, 2, 3}.issubset(covered)
+    assert set(range(7, 12)).issubset(covered)
+
+
+@pytest.mark.parametrize("use_native", BACKENDS)
 def test_lone_point_chain_never_complete(use_native):
     if use_native and not native.available():
         pytest.skip("native toolchain unavailable")

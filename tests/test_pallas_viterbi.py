@@ -94,3 +94,29 @@ class TestDispatch:
         monkeypatch.setenv("REPORTER_TPU_DECODE", "scan")
         s = decode_batch(*args)
         np.testing.assert_array_equal(np.asarray(p[0]), np.asarray(s[0]))
+
+
+def test_default_backend_is_scan_on_lone_cpu_device():
+    """The unforced default must be scan on a SINGLE CPU device (assoc's
+    O(K^3) is a measured ~4x decode loss there); conftest forces an
+    8-device mesh in this process, so probe in a child interpreter."""
+    import os
+    import subprocess
+    import sys
+
+    code = (
+        "import sys; sys.path.insert(0, sys.argv[1])\n"
+        "from reporter_tpu.utils.runtime import force_virtual_cpu\n"
+        "force_virtual_cpu()\n"  # no count: one CPU device
+        "import jax\n"
+        "assert len(jax.local_devices()) == 1, jax.local_devices()\n"
+        "from reporter_tpu.ops import decode_backend\n"
+        "print(decode_backend(64, 8))\n")
+    env = dict(os.environ)
+    env.pop("REPORTER_TPU_DECODE", None)
+    env.pop("XLA_FLAGS", None)  # drop the 8-device flag
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = subprocess.run([sys.executable, "-c", code, repo], env=env,
+                         capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr[-1500:]
+    assert out.stdout.strip().splitlines()[-1] == "scan"
