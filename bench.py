@@ -19,9 +19,10 @@ warm, shapes compiled — a long-running city service):
 
   batched leg  — this framework's architecture: SegmentMatcher.match_many
   (ONE native prep call per chunk — C++ candidates/jitter-filter/route
-  matrices straight into padded tensors — vmapped associative-scan
-  Viterbi on the accelerator, async d2h, ONE native assembly call per
-  batch) + report().
+  matrices straight into padded tensors — the platform-default batched
+  Viterbi (assoc on accelerators/meshes, scan on a lone CPU device;
+  ops.decode_backend), async d2h, ONE native assembly call per batch)
+  + report().
 
 ``vs_baseline`` is batched/baseline throughput — the architectural
 speedup toward BASELINE.md's >=50x-over-single-process-Meili north star,

@@ -110,9 +110,10 @@ def decode_batch(dist_m, valid, route_m, gc_m, case, sigma, beta):
     """Backend-dispatched batched Viterbi decode; same contract as
     matcher.hmm.viterbi_decode_batch.
 
-    Accepts f32 tensors or the f16 wire format (built by
-    matcher.batchpad.pack_batches, the single owner of the wire policy) —
-    the scoring kernels upcast on device either way.
+    Accepts f32 tensors or the f16 wire format (matcher.batchpad owns
+    the wire policy — pack_batches on the fallback path, prepare_batch
+    on the native path) — the scoring kernels upcast on device either
+    way.
 
     With more than one visible device, batches whose dims divide the
     process mesh run sharded (data-parallel over traces, optionally
