@@ -181,6 +181,39 @@ def test_prepare_batch_wire_dtype_decision(matcher, traces):
         assert b2.route_m.dtype == np.float32, n_threads
 
 
+@pytest.mark.parametrize("seed", [1, 7, 19, 42])
+def test_native_numpy_parity_sweep(seed):
+    """Byte-identical match dicts across varied cities/params — broad
+    insurance against native/numpy drift beyond the fixed-seed tests."""
+    rows = 6 + (seed % 3) * 2
+    city = build_grid_city(rows=rows, cols=rows, spacing_m=150.0 + seed,
+                           seed=seed)
+    params = MatchParams(
+        max_candidates=8,
+        turn_penalty_factor=250.0 if seed % 2 else 0.0,
+        search_radius=45.0 if seed % 3 == 0 else 50.0)
+    rng = np.random.default_rng(seed)
+    reqs = []
+    attempts = 0
+    while len(reqs) < 10 and attempts < 2000:
+        attempts += 1
+        tr = generate_trace(city, f"s{seed}-{len(reqs)}", rng,
+                            noise_m=3.0 + (seed % 4),
+                            min_route_edges=4, max_route_edges=16)
+        if tr is None or len(tr.points) < 4:
+            continue
+        r = tr.request_json()
+        r["trace"] = tr.points[:60]
+        r["match_options"] = {"mode": "auto", "report_levels": [0, 1, 2],
+                              "transition_levels": [0, 1, 2]}
+        reqs.append(r)
+    assert len(reqs) >= 6, f"seed {seed}: too few traces generated"
+    a = SegmentMatcher(net=city, params=params).match_many(reqs)
+    b = SegmentMatcher(net=city, params=params,
+                       use_native=False).match_many(reqs)
+    assert a == b
+
+
 def test_all_decode_backends_accept_t_row_route(matcher, traces):
     """Native prep ships route/gc with T time rows (dead trailing step
     for seq sharding); every decode backend must shed it identically
