@@ -60,30 +60,29 @@ def build_inputs(n_traces, T_bucket, K):
     params = MatchParams(max_candidates=K)
     matcher = SegmentMatcher(net=city, params=params)
     rng = np.random.default_rng(7)
-    prepared, reqs = [], []
+    reqs = []
     # routes long enough to fill the bucket at ~1 point/sec, then sliced
     min_edges = max(4, T_bucket // 12)
     attempts = 0
-    while len(prepared) < n_traces:
+    while len(reqs) < n_traces:
         attempts += 1
         if attempts > 50 * n_traces:
             raise RuntimeError(f"could not build T={T_bucket} traces")
-        tr = generate_trace(city, f"veh-{len(prepared)}", rng, noise_m=4.0,
+        tr = generate_trace(city, f"veh-{len(reqs)}", rng, noise_m=4.0,
                             min_route_edges=min_edges, max_route_edges=60)
         if tr is None or len(tr.points) < T_bucket // 2:
             continue
         points = tr.points[:T_bucket]
-        p = matcher.prepare(points)
-        if p.T != T_bucket:
+        # prepared only to check the trace fills the bucket exactly
+        if matcher.prepare(points).T != T_bucket:
             continue
-        prepared.append(p)
         req = tr.request_json()
         req["trace"] = points
         req["match_options"] = {"mode": "auto",
                                 "report_levels": [0, 1, 2],
                                 "transition_levels": [0, 1, 2]}
         reqs.append(req)
-    return city, matcher, params, prepared, reqs
+    return city, matcher, params, reqs
 
 
 def _time_batched_leg(matcher, reqs, make_report, repeats):
@@ -138,8 +137,7 @@ def main():
     from reporter_tpu.service.report import report as make_report
 
     platform = jax.devices()[0].platform
-    city, matcher, params, prepared, reqs = build_inputs(
-        n_traces, T_bucket, K)
+    city, matcher, params, reqs = build_inputs(n_traces, T_bucket, K)
     sigma = np.float32(params.effective_sigma)
     beta = np.float32(params.beta)
 

@@ -296,19 +296,6 @@ def prepare_batch(runtime, traces_points: Sequence[Sequence[dict]],
                        pt_off=pt_off, times_flat=times)
 
 
-def _f16_safe_arrays(route: np.ndarray, dist: np.ndarray,
-                     gc: np.ndarray) -> bool:
-    """Batch-tensor analog of :func:`_f16_safe` (one vectorised pass)."""
-    if gc.size and float(np.amax(gc)) > WIRE_MAX_M:
-        return False
-    for arr in (route, dist):
-        if arr.size and float(np.amax(
-                arr, initial=0.0,
-                where=arr < UNREACHABLE_THRESHOLD)) > WIRE_MAX_M:
-            return False
-    return True
-
-
 def _wire_f16() -> bool:
     import logging
     import os
@@ -323,14 +310,36 @@ def _wire_f16() -> bool:
 
 def _f16_safe(p: PreparedTrace) -> bool:
     """True when every finite distance in the trace fits the f16 wire
-    undistorted (sentinel values >= UNREACHABLE_THRESHOLD travel as +inf).
-    Delegates to the batch-tensor predicate so the per-trace and batched
-    paths can never choose different wire dtypes."""
-    return _f16_safe_arrays(p.route_m, p.dist_m, p.gc_m)
+    undistorted (sentinel values >= UNREACHABLE_THRESHOLD travel as +inf;
+    the native batched path decides from the C++-computed max_finite
+    scalar instead of re-scanning)."""
+    if p.gc_m.size and float(np.amax(p.gc_m)) > WIRE_MAX_M:
+        return False
+    for arr in (p.route_m, p.dist_m):
+        if arr.size and float(np.amax(
+                arr, initial=0.0,
+                where=arr < UNREACHABLE_THRESHOLD)) > WIRE_MAX_M:
+            return False
+    return True
 
 
 def _next_pow2(n: int) -> int:
     return 1 << max(n - 1, 0).bit_length()
+
+
+def padded_batch_rows(B: int, pad: "int | None", pow2: bool = True) -> int:
+    """Batch rows after mesh-multiple + pow2 padding — the ONE padding
+    policy shared by pack_batches and the native dispatch (pow2 bounds
+    the compiled-shape count per bucket; it never breaks mesh
+    divisibility)."""
+    rows = B
+    if pad:
+        rows = ((rows + pad - 1) // pad) * pad
+    if pow2:
+        p2 = _next_pow2(rows)
+        if not pad or p2 % pad == 0:
+            rows = p2
+    return rows
 
 
 def pack_batches(prepared: Sequence[PreparedTrace],
@@ -381,13 +390,7 @@ def pack_batches(prepared: Sequence[PreparedTrace],
 
     batches = []
     for T, group, pad, dtype in chunked:
-        B = len(group)
-        if pad:
-            B = ((B + pad - 1) // pad) * pad
-        if pad_pow2:
-            B2 = _next_pow2(B)
-            if not pad or B2 % pad == 0:  # never break mesh divisibility
-                B = B2
+        B = padded_batch_rows(len(group), pad, pow2=pad_pow2)
         K = group[0].edge_ids.shape[1]
         with np.errstate(over="ignore"):  # sentinels overflow f16 to +inf
             dist = np.full((B, T, K), PAD_DIST, dtype=dtype)

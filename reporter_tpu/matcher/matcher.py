@@ -26,8 +26,8 @@ from ..graph.route import RouteCache
 from ..graph.spatial import SpatialGrid
 from ..utils import metrics
 from .assemble import assemble_segments
-from .batchpad import (bucket_length, pack_batches, prepare_batch,
-                       prepare_trace)
+from .batchpad import (bucket_length, pack_batches, padded_batch_rows,
+                       prepare_batch, prepare_trace)
 from .params import MatchParams
 
 # process-wide configuration, mirroring valhalla.Configure's module-level
@@ -50,19 +50,6 @@ def _prep_workers() -> int:
                                   min(32, os.cpu_count() or 1)))
     except ValueError:
         return min(32, os.cpu_count() or 1)
-
-
-def _pad_rows(B: int, pad) -> int:
-    """Batch rows after mesh-multiple + pow2 padding (the same policy as
-    pack_batches(pad_batch_to=pad, pad_pow2=True): pow2 bounds the
-    compiled-shape count per bucket, never breaking mesh divisibility)."""
-    rows = B
-    if pad:
-        rows = ((rows + pad - 1) // pad) * pad
-    p2 = 1 << max(rows - 1, 0).bit_length()
-    if not pad or p2 % pad == 0:
-        rows = p2
-    return rows
 
 
 def _format_runs(runs: dict, lo: int, hi: int, mode: str) -> dict:
@@ -327,7 +314,7 @@ class SegmentMatcher:
                 for lo in range(0, len(bucket), chunk):
                     part = bucket[lo:lo + chunk]
                     order = [i for i, _tr in part]
-                    rows = _pad_rows(len(part), pad)
+                    rows = padded_batch_rows(len(part), pad)
                     with metrics.timer("matcher.prep"):
                         batch = prepare_batch(
                             self.runtime, [tr["trace"] for _i, tr in part],

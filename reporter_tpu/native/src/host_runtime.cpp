@@ -574,8 +574,7 @@ void rt_prepare_batch(void* handle, int64_t n_traces, const int64_t* pt_off,
                          std::vector<int32_t>& edge_raw,
                          std::vector<float>& dist_raw,
                          std::vector<float>& off_raw,
-                         std::vector<int32_t>& kept,
-                         std::vector<double>& gc_kept) {
+                         std::vector<int32_t>& kept) {
     float local_max = 0.0f;
     const int64_t p0 = pt_off[b], p1 = pt_off[b + 1];
     const int64_t n_raw = p1 - p0;
@@ -652,7 +651,6 @@ void rt_prepare_batch(void* handle, int64_t n_traces, const int64_t* pt_off,
     }
 
     // gather kept rows into the padded outputs; gc + case codes
-    gc_kept.resize(n > 1 ? n - 1 : 0);
     for (int32_t t = 0; t < n; ++t) {
       const int64_t p = kept[t];
       std::memcpy(edge_b + t * K, edge_raw.data() + p * K,
@@ -669,7 +667,6 @@ void rt_prepare_batch(void* handle, int64_t n_traces, const int64_t* pt_off,
         const int64_t pp = kept[t - 1];
         const double gc = equirect_m(lat[p0 + pp], lon[p0 + pp],
                                      lat[p0 + p], lon[p0 + p]);
-        gc_kept[t - 1] = gc;
         gc_b[t - 1] = static_cast<float>(gc);
         // compare the FLOAT32 gc, as batchpad.prepare_trace does (it
         // casts gc to f32 before the breakage test) — a gap within one
@@ -713,9 +710,8 @@ void rt_prepare_batch(void* handle, int64_t n_traces, const int64_t* pt_off,
     CandScratch scratch(g->n_edges);
     std::vector<int32_t> edge_raw, kept;
     std::vector<float> dist_raw, off_raw;
-    std::vector<double> gc_kept;
     for (int64_t b = 0; b < n_traces; ++b)
-      prepare_one(b, scratch, edge_raw, dist_raw, off_raw, kept, gc_kept);
+      prepare_one(b, scratch, edge_raw, dist_raw, off_raw, kept);
     *out_max_finite = max_finite.load();
     return;
   }
@@ -727,11 +723,10 @@ void rt_prepare_batch(void* handle, int64_t n_traces, const int64_t* pt_off,
       CandScratch scratch(g->n_edges);
       std::vector<int32_t> edge_raw, kept;
       std::vector<float> dist_raw, off_raw;
-      std::vector<double> gc_kept;
       for (;;) {
         const int64_t b = next.fetch_add(1);
         if (b >= n_traces) return;
-        prepare_one(b, scratch, edge_raw, dist_raw, off_raw, kept, gc_kept);
+        prepare_one(b, scratch, edge_raw, dist_raw, off_raw, kept);
       }
     });
   }
