@@ -80,6 +80,63 @@ def test_punctuate_skips_below_relaxed_thresholds():
     assert not b.store
 
 
+def test_submit_many_failure_granularity():
+    """A failing device batch costs only ITS traces: submit_many with
+    return_exceptions surfaces the error in-place, and report_many turns
+    it into per-trace Nones without discarding other batches' results."""
+    from reporter_tpu.service.dispatch import BatchDispatcher
+
+    def match_many(traces):
+        if any(t.get("poison") for t in traces):
+            raise RuntimeError("boom")
+        return [{"segments": [], "mode": "auto"} for _ in traces]
+
+    # max_batch=2: [ok, ok] then [poison, ok] form separate batches
+    # generous wait: full batches still flush instantly at
+    # max_batch=2; the margin only removes scheduler-jitter flake
+    d = BatchDispatcher(match_many, max_batch=2, max_wait_ms=2000.0)
+    try:
+        traces = [{"uuid": "a"}, {"uuid": "b"},
+                  {"uuid": "c", "poison": True}, {"uuid": "d"}]
+        results = d.submit_many(traces, return_exceptions=True)
+        assert results[0] == {"segments": [], "mode": "auto"}
+        assert results[1] == {"segments": [], "mode": "auto"}
+        assert isinstance(results[2], RuntimeError)
+        assert isinstance(results[3], RuntimeError)  # same poisoned batch
+
+        # without return_exceptions the error raises
+        with pytest.raises(RuntimeError):
+            d.submit_many([{"uuid": "x", "poison": True}])
+    finally:
+        d.close()
+
+
+def test_report_many_partial_failure_keeps_good_traces():
+    from reporter_tpu.service.server import ReporterService
+
+    class FakeMatcher:
+        def match_many(self, traces):
+            if any(t.get("poison") for t in traces):
+                raise RuntimeError("boom")
+            return [{"segments": [], "mode": "auto"} for _ in traces]
+
+    svc = ReporterService(FakeMatcher(), threshold_sec=15, max_batch=2,
+                          max_wait_ms=2000.0)
+    try:
+        opts = {"report_levels": [0, 1], "transition_levels": [0, 1]}
+        mk = lambda uuid, poison=False: {
+            "uuid": uuid, "poison": poison, "match_options": opts,
+            "trace": [{"lat": 0.0, "lon": 0.0, "time": 0},
+                      {"lat": 0.0, "lon": 0.0, "time": 5}]}
+        out = svc.report_many([mk("a"), mk("b"),
+                               mk("c", poison=True), mk("d")])
+        assert out[0] is not None and out[1] is not None
+        assert "datastore" in out[0]
+        assert out[2] is None and out[3] is None  # only the poisoned batch
+    finally:
+        svc.dispatcher.close()
+
+
 def test_eviction_batch_reaches_matcher_as_one_call(tmp_path):
     from reporter_tpu.matcher import MatchParams, SegmentMatcher
     from reporter_tpu.service.server import ReporterService
