@@ -88,6 +88,7 @@ def build_inputs(n_traces, T_bucket, K):
 def _time_batched_leg(matcher, reqs, make_report, repeats):
     """Best-of-N end-to-end timing of match_many + report; returns
     (best_seconds, stage breakdown of the best run)."""
+    from reporter_tpu.matcher import pipeline_enabled
     from reporter_tpu.utils import metrics
 
     best, best_stages = float("inf"), {}
@@ -109,6 +110,10 @@ def _time_batched_leg(matcher, reqs, make_report, repeats):
                 if name in timers}
             best_stages["report"] = round(elapsed - (t_match - t0), 6)
             best_stages["total"] = round(elapsed, 6)
+            # the device lanes overlap decode/assemble with prep of later
+            # chunks, so stage seconds can sum past the wall total; set
+            # REPORTER_TPU_PIPELINE=0 for a serialized breakdown
+            best_stages["pipelined"] = pipeline_enabled()
     return best, best_stages
 
 

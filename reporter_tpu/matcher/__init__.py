@@ -1,11 +1,11 @@
 from .params import MatchParams
 from .hmm import viterbi_decode_batch, NORMAL, RESTART, SKIP, NEG_INF  # noqa: F401
 from .assemble import assemble_segments
-from .matcher import SegmentMatcher, Configure
+from .matcher import SegmentMatcher, Configure, pipeline_enabled
 
 __all__ = [
     "MatchParams",
     "viterbi_decode_batch", "NORMAL", "RESTART", "SKIP", "NEG_INF",
     "assemble_segments",
-    "SegmentMatcher", "Configure",
+    "SegmentMatcher", "Configure", "pipeline_enabled",
 ]

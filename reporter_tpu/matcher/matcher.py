@@ -52,6 +52,15 @@ def _prep_workers() -> int:
         return min(32, os.cpu_count() or 1)
 
 
+def pipeline_enabled() -> bool:
+    """Overlap the device lanes (decode dispatch; d2h wait + assembly)
+    with host prep of later chunks. Default on; REPORTER_TPU_PIPELINE=0
+    runs both stages inline — same results, serialized stages (useful
+    when a clean per-stage wall-time breakdown is wanted)."""
+    return os.environ.get("REPORTER_TPU_PIPELINE", "1").strip().lower() \
+        not in ("0", "off", "false")
+
+
 def _format_runs(runs: dict, lo: int, hi: int, mode: str) -> dict:
     """Native assembler run columns [lo, hi) -> the reference-schema match
     dict (same keys/values as matcher.assemble.assemble_segments;
@@ -147,6 +156,18 @@ class SegmentMatcher:
         # ops are atomic under the GIL (races cost a redundant dijkstra,
         # never corruption).
         self._prep_pool: Optional[ThreadPoolExecutor] = None
+        # two single-worker device lanes, each FIFO: the dispatch lane
+        # runs decode dispatch + async d2h so the device queue stays fed,
+        # the drain lane runs the d2h wait + assembly — so chunk N's
+        # decode overlaps both host prep of chunk N+1 (main thread) and
+        # assembly of chunk N-1 (drain lane). Constructed here (worker
+        # threads only spawn on first submit; GC of the matcher releases
+        # them) so concurrent first calls can't race a lazy check-then-set
+        # into duplicate lanes.
+        self._dispatch_pool = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="device-dispatch")
+        self._drain_pool = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="device-drain")
 
     @property
     def grid(self) -> SpatialGrid:
@@ -208,11 +229,16 @@ class SegmentMatcher:
         "match_options": {...}} — per-trace match_options may override
         params (reference: generate_test_trace.py:45-52).
 
-        Chunked dispatch pipeline: host prep (one native call per chunk
-        when the C++ runtime is present — zero per-trace Python), async
-        device decode + d2h, then assembly after the last dispatch — so
-        chunk N+1's prep overlaps chunk N's decode, and decode of late
-        chunks overlaps assembly of early ones.
+        Chunked dispatch pipeline: the main thread runs host prep (one
+        native call per chunk when the C++ runtime is present — zero
+        per-trace Python) and hands each prepared chunk to two
+        single-worker FIFO lanes: the dispatch lane runs decode dispatch
+        + async d2h (so the device queue stays fed and, over a TPU
+        tunnel, h2d transfers stream off the main thread), the drain
+        lane runs the d2h wait + assembly. Chunk N's decode therefore
+        overlaps prep of chunk N+1 AND assembly of chunk N-1.
+        REPORTER_TPU_PIPELINE=0 runs both stages inline for a serialized
+        per-stage breakdown.
         """
         per_trace_params = [
             self.params.with_options(tr.get("match_options", {}))
@@ -230,40 +256,104 @@ class SegmentMatcher:
         if pad:
             chunk = ((chunk + pad - 1) // pad) * pad
 
-        if self.runtime is not None:
-            pending, prepared = self._dispatch_native(
-                traces, per_trace_params, chunk, pad, decode_batch)
-        else:
-            pending, prepared = self._dispatch_fallback(
-                traces, per_trace_params, chunk, pad, decode_batch)
-
         results: List[Optional[dict]] = [None] * len(traces)
-        for batch, order, decoded in pending:
-            with metrics.timer("matcher.decode_wait"):
-                decoded = np.asarray(decoded)
-            if batch.prep is not None:
-                # native batched assembly: ONE call walks every decoded
-                # path of this batch into run records; Python only
-                # formats the reference-schema dicts
-                B = len(batch.traces)
-                gp = per_trace_params[order[0]]
-                with metrics.timer("matcher.assemble"):
-                    runs = self.runtime.assemble_batch(
-                        decoded[:B], batch.prep, batch.pt_off,
-                        batch.times_flat,
-                        queue_threshold_kph=gp.queue_speed_threshold_kph,
-                        interpolation_distance_m=gp.interpolation_distance,
-                        backward_tolerance_m=gp.backward_tolerance_m,
-                        turn_penalty_factor=gp.turn_penalty_factor)
-                    ro = runs["run_off"]
-                    for b, i in enumerate(order):
-                        results[i] = _format_runs(
-                            runs, int(ro[b]), int(ro[b + 1]),
-                            per_trace_params[i].mode)
+        futures = []
+        if pipeline_enabled():
+            def submit(batch, order, sigma, beta):
+                d_fut = self._dispatch_pool.submit(
+                    self._dispatch_stage, batch, sigma, beta, decode_batch)
+                futures.append((d_fut, self._drain_pool.submit(
+                    self._drain_stage, batch, order, d_fut,
+                    per_trace_params, results)))
+        else:
+            def submit(batch, order, sigma, beta):
+                decoded = self._dispatch_stage(batch, sigma, beta,
+                                               decode_batch)
+                self._drain_stage(batch, order, decoded,
+                                  per_trace_params, results)
+
+        try:
+            if self.runtime is not None:
+                self._dispatch_native(traces, per_trace_params, chunk, pad,
+                                      submit)
             else:
-                idx_of = {id(prepared[i]): i for i in order}
-                for b, p in enumerate(batch.traces):
-                    i = idx_of[id(p)]
+                self._dispatch_fallback(traces, per_trace_params, chunk,
+                                        pad, submit)
+        except BaseException:
+            # a prep-phase failure must quiesce the lanes before it
+            # propagates: later chunks must not keep decoding discarded
+            # work into the next call (shared FIFO lanes, shared timers).
+            # Cancel assembly before decode so neither stage starts late.
+            for d_fut, a_fut in futures:
+                for f in (a_fut, d_fut):
+                    if not f.cancel():
+                        try:
+                            f.result()
+                        except BaseException:
+                            pass
+            raise
+        # drain EVERY chunk, then surface the first failure in
+        # submission order (matches the inline path's raise point); a
+        # dispatch-lane error re-raises out of its drain future, so the
+        # drain futures cover both lanes
+        first_err = None
+        for _d_fut, a_fut in futures:
+            try:
+                a_fut.result()
+            except BaseException as e:
+                if first_err is None:
+                    first_err = e
+        if first_err is not None:
+            raise first_err
+        return results
+
+    def _dispatch_stage(self, batch, sigma, beta, decode_batch):
+        """Dispatch lane: decode dispatch + async d2h for one chunk.
+        Returns the in-flight device array without waiting on it, so the
+        next chunk's dispatch isn't gated on this one's results."""
+        with metrics.timer("matcher.decode_dispatch"):
+            decoded, _scores = decode_batch(
+                batch.dist_m, batch.valid, batch.route_m,
+                batch.gc_m, batch.case, sigma, beta)
+            if hasattr(decoded, "copy_to_host_async"):
+                decoded.copy_to_host_async()
+        return decoded
+
+    def _drain_stage(self, batch, order, decoded, per_trace_params,
+                     results) -> None:
+        """Drain lane: d2h wait + assembly + result formatting for one
+        chunk. ``decoded`` is the dispatch stage's device array, or a
+        Future of it on the pipelined path; writes into ``results`` slots
+        owned exclusively by this chunk's ``order``."""
+        if hasattr(decoded, "result"):
+            decoded = decoded.result()
+        with metrics.timer("matcher.decode_wait"):
+            decoded = np.asarray(decoded)
+        if batch.prep is not None:
+            # native batched assembly: ONE call walks every decoded
+            # path of this batch into run records; Python only
+            # formats the reference-schema dicts
+            B = len(batch.traces)
+            gp = per_trace_params[order[0]]
+            with metrics.timer("matcher.assemble"):
+                runs = self.runtime.assemble_batch(
+                    decoded[:B], batch.prep, batch.pt_off,
+                    batch.times_flat,
+                    queue_threshold_kph=gp.queue_speed_threshold_kph,
+                    interpolation_distance_m=gp.interpolation_distance,
+                    backward_tolerance_m=gp.backward_tolerance_m,
+                    turn_penalty_factor=gp.turn_penalty_factor)
+                ro = runs["run_off"]
+                for b, i in enumerate(order):
+                    results[i] = _format_runs(
+                        runs, int(ro[b]), int(ro[b + 1]),
+                        per_trace_params[i].mode)
+        else:
+            # order is elementwise-aligned with batch.traces (the
+            # dispatchers build it that way), so row b IS trace order[b]
+            with metrics.timer("matcher.assemble"):
+                for b, i in enumerate(order):
+                    p = batch.traces[b]
                     params = per_trace_params[i]
                     results[i] = assemble_segments(
                         self.net, p, decoded[b], mode=params.mode,
@@ -271,7 +361,6 @@ class SegmentMatcher:
                         interpolation_distance_m=params.interpolation_distance,
                         backward_tolerance_m=params.backward_tolerance_m,
                         turn_penalty_factor=params.turn_penalty_factor)
-        return results
 
     # every param that shapes the prepared tensors or the batched
     # assembly: traces may only share one native prep call (and one device
@@ -285,19 +374,16 @@ class SegmentMatcher:
         "queue_speed_threshold_kph")
 
     def _dispatch_native(self, traces, per_trace_params, chunk, pad,
-                         decode_batch):
+                         submit):
         """Hot path: group by prep params, bucket by raw length, then ONE
-        rt_prepare_batch call + one decode dispatch per chunk."""
+        rt_prepare_batch call per chunk on this thread, handing each
+        prepared batch to ``submit`` (the device lanes)."""
         groups: dict[tuple, list] = {}
         for i, (tr, params) in enumerate(zip(traces, per_trace_params)):
             key = tuple(getattr(params, f) for f in self._PREP_KEY_FIELDS)
             groups.setdefault(key, []).append((i, tr, params))
 
         workers = max(1, _prep_workers())
-        # no per-trace prepared map on this path: the drain reads run
-        # records straight off each batch (batch.prep), never per-trace
-        # PreparedTrace objects
-        pending = []
         for key, items in groups.items():
             params = items[0][2]
             sigma = np.float32(params.effective_sigma)
@@ -319,17 +405,10 @@ class SegmentMatcher:
                         batch = prepare_batch(
                             self.runtime, [tr["trace"] for _i, tr in part],
                             params, T, pad_rows=rows, n_threads=workers)
-                    with metrics.timer("matcher.decode_dispatch"):
-                        decoded, _scores = decode_batch(
-                            batch.dist_m, batch.valid, batch.route_m,
-                            batch.gc_m, batch.case, sigma, beta)
-                        if hasattr(decoded, "copy_to_host_async"):
-                            decoded.copy_to_host_async()
-                    pending.append((batch, order, decoded))
-        return pending, {}
+                    submit(batch, order, sigma, beta)
 
     def _dispatch_fallback(self, traces, per_trace_params, chunk, pad,
-                           decode_batch):
+                           submit):
         """numpy prep path (no native library): per-trace prepare_trace +
         pack_batches — same contract, slower."""
         groups: dict[tuple, list] = {}
@@ -337,22 +416,16 @@ class SegmentMatcher:
             key = (params.effective_sigma, params.beta)
             groups.setdefault(key, []).append((i, tr, params))
 
-        prepared: dict[int, object] = {}
-        pending = []
         for (sigma, beta), items in groups.items():
             for lo in range(0, len(items), chunk):
-                prepped = self._prep_map(items[lo:lo + chunk])
-                for i, p in prepped:
-                    prepared[i] = p
+                with metrics.timer("matcher.prep"):
+                    prepped = self._prep_map(items[lo:lo + chunk])
+                idx_of = {id(p): i for i, p in prepped}
                 group = [p for _i, p in prepped]
-                order = [i for i, _p in prepped]
                 for batch in pack_batches(group, pad_batch_to=pad,
                                           pad_pow2=True):
-                    decoded, _scores = decode_batch(
-                        batch.dist_m, batch.valid, batch.route_m,
-                        batch.gc_m, batch.case,
-                        np.float32(sigma), np.float32(beta))
-                    if hasattr(decoded, "copy_to_host_async"):
-                        decoded.copy_to_host_async()
-                    pending.append((batch, order, decoded))
-        return pending, prepared
+                    # rows of a packed batch align with its traces list,
+                    # so order[b] is the global index of batch.traces[b]
+                    order = [idx_of[id(p)] for p in batch.traces]
+                    submit(batch, order, np.float32(sigma),
+                           np.float32(beta))

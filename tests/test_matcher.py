@@ -288,3 +288,61 @@ class TestWireEncoding:
                     for t in traces]
         batches = pack_batches(prepared, max_batch=128)
         assert all(b.dist_m.shape[0] == len(b.traces) for b in batches)
+
+
+class TestDevicePipeline:
+    """The device lane (decode dispatch + wait + assembly on a worker
+    thread, overlapping host prep of later chunks) must be a pure
+    performance change: byte-identical results to the inline path, chunk
+    order preserved across buckets, and lane errors raised to the
+    caller."""
+
+    def _reqs(self, city, n=10):
+        reqs = []
+        for seed in range(n - 2):
+            reqs.append(make_trace(city, seed=300 + seed).request_json())
+        for seed in (390, 391):  # a second T bucket -> extra chunks
+            reqs.append(make_trace(city, seed=seed, min_route_edges=16,
+                                   max_route_edges=22).request_json())
+        return reqs
+
+    @pytest.mark.parametrize("use_native", [True, False])
+    def test_pipelined_matches_inline(self, city, monkeypatch, use_native):
+        from reporter_tpu import native
+        if use_native and not native.available():
+            pytest.skip("native runtime unavailable")
+        # small chunks force several lane submissions per call (the mesh
+        # pad may round the chunk up; with 8 same-bucket traces that
+        # still yields multiple chunks alongside the long-trace bucket)
+        monkeypatch.setenv("REPORTER_TPU_DECODE_CHUNK", "2")
+        m = SegmentMatcher(net=city, use_native=use_native)
+        reqs = self._reqs(city)
+        monkeypatch.setenv("REPORTER_TPU_PIPELINE", "0")
+        inline = m.match_many(reqs)
+        monkeypatch.setenv("REPORTER_TPU_PIPELINE", "1")
+        piped = m.match_many(reqs)
+        assert piped == inline
+        assert all(r is not None for r in piped)
+
+    def test_lane_error_propagates(self, city, monkeypatch):
+        import reporter_tpu.ops as ops
+
+        def boom(*a, **kw):
+            raise RuntimeError("decode exploded")
+
+        monkeypatch.setattr(ops, "decode_batch", boom)
+        m = SegmentMatcher(net=city)
+        with pytest.raises(RuntimeError, match="decode exploded"):
+            m.match_many(self._reqs(city, n=4))
+
+    def test_prep_failure_quiesces_lanes(self, city, monkeypatch):
+        """A malformed trace mid-dispatch must raise AND leave the shared
+        lanes drained so the matcher stays usable."""
+        monkeypatch.setenv("REPORTER_TPU_DECODE_CHUNK", "2")
+        m = SegmentMatcher(net=city)
+        good = self._reqs(city, n=4)
+        bad = good[:3] + [{"uuid": "broken"}] + good[3:]  # no "trace" key
+        with pytest.raises(KeyError):
+            m.match_many(bad)
+        after = m.match_many(good)
+        assert all(r and r["segments"] for r in after)
