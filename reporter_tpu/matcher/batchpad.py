@@ -192,10 +192,38 @@ def prepare_trace(net: RoadNetwork, grid: SpatialGrid | None,
                          has_cands=np.asarray(has_cands))
 
 
+class _LazyTraceViews:
+    """Sequence of PreparedTrace views built on first element access.
+
+    The native hot path (SegmentMatcher._drain_stage with batched
+    assembly) only ever needs ``len()`` — building 512 dataclass views
+    with 8 numpy slices each cost ~3 ms per chunk for nothing. Tests
+    and the fallback assembler index/iterate, which materialises."""
+
+    def __init__(self, n: int, build):
+        self._n = n
+        self._build = build
+        self._views: List[PreparedTrace] | None = None
+
+    def _mat(self) -> List[PreparedTrace]:
+        if self._views is None:
+            self._views = self._build()
+        return self._views
+
+    def __len__(self) -> int:
+        return self._n
+
+    def __getitem__(self, i):
+        return self._mat()[i]
+
+    def __iter__(self):
+        return iter(self._mat())
+
+
 @dataclass
 class PaddedBatch:
     """A device-ready batch of same-bucket traces."""
-    traces: List[PreparedTrace]
+    traces: "List[PreparedTrace] | _LazyTraceViews"
     dist_m: np.ndarray   # (B, T, K) f32
     valid: np.ndarray    # (B, T, K) bool
     # route/gc time rows: T-1 on the numpy pack_batches path, T on the
@@ -263,23 +291,26 @@ def prepare_batch(runtime, traces_points: Sequence[Sequence[dict]],
         turn_penalty_factor=params.turn_penalty_factor,
         n_threads=n_threads, n_rows=pad_rows)
 
-    edge_ids, kept, num_kept = out["edge_ids"], out["kept_idx"], \
-        out["num_kept"]
-    views = []
-    for b in range(B):
-        nk = int(num_kept[b])
-        views.append(PreparedTrace(
-            num_raw=counts[b], num_kept=nk, kept_idx=kept[b, :nk],
-            times=times[pt_off[b]:pt_off[b + 1]],
-            edge_ids=edge_ids[b], dist_m=out["dist_m"][b],
-            offset_m=out["offset_m"][b],
-            # the batch tensors carry T time rows (dead trailing step,
-            # for seq sharding); the per-trace view keeps the documented
-            # (T-1, ...) contract — a contiguous slice, no copy
-            route_m=out["route_m"][b, :max(T - 1, 0)],
-            gc_m=out["gc_m"][b, :max(T - 1, 0)], case=out["case"][b],
-            trailing_jitter_dwell_s=float(out["dwell"][b]),
-            has_cands=out["has_cands"][pt_off[b]:pt_off[b + 1]]))
+    def build_views() -> List[PreparedTrace]:
+        edge_ids, kept, num_kept = out["edge_ids"], out["kept_idx"], \
+            out["num_kept"]
+        views = []
+        for b in range(B):
+            nk = int(num_kept[b])
+            views.append(PreparedTrace(
+                num_raw=counts[b], num_kept=nk, kept_idx=kept[b, :nk],
+                times=times[pt_off[b]:pt_off[b + 1]],
+                edge_ids=edge_ids[b], dist_m=out["dist_m"][b],
+                offset_m=out["offset_m"][b],
+                # the batch tensors carry T time rows (dead trailing
+                # step, for seq sharding); the per-trace view keeps the
+                # documented (T-1, ...) contract — a contiguous slice,
+                # no copy
+                route_m=out["route_m"][b, :max(T - 1, 0)],
+                gc_m=out["gc_m"][b, :max(T - 1, 0)], case=out["case"][b],
+                trailing_jitter_dwell_s=float(out["dwell"][b]),
+                has_cands=out["has_cands"][pt_off[b]:pt_off[b + 1]]))
+        return views
 
     # wire dtype: one vectorised decision + cast for the whole batch
     # (sentinels overflow f16 to +inf, which device scoring treats
@@ -290,8 +321,8 @@ def prepare_batch(runtime, traces_points: Sequence[Sequence[dict]],
         dist = runtime.to_f16(dist)
         route = runtime.to_f16(route)
         gc = runtime.to_f16(gc)
-    return PaddedBatch(traces=views, dist_m=dist,
-                       valid=edge_ids != PAD_EDGE, route_m=route,
+    return PaddedBatch(traces=_LazyTraceViews(B, build_views), dist_m=dist,
+                       valid=out["edge_ids"] != PAD_EDGE, route_m=route,
                        gc_m=gc, case=out["case"], prep=out,
                        pt_off=pt_off, times_flat=times)
 

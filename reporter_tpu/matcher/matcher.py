@@ -283,14 +283,17 @@ class SegmentMatcher:
             # a prep-phase failure must quiesce the lanes before it
             # propagates: later chunks must not keep decoding discarded
             # work into the next call (shared FIFO lanes, shared timers).
-            # Cancel assembly before decode so neither stage starts late.
-            for d_fut, a_fut in futures:
-                for f in (a_fut, d_fut):
-                    if not f.cancel():
-                        try:
-                            f.result()
-                        except BaseException:
-                            pass
+            # Two passes: cancel EVERYTHING still queued first (waiting
+            # pair-by-pair would let the single-worker lanes dequeue and
+            # run later chunks to completion), then wait out whatever had
+            # already started.
+            running = [f for pair in futures for f in reversed(pair)
+                       if not f.cancel()]
+            for f in running:
+                try:
+                    f.result()
+                except BaseException:
+                    pass
             raise
         # drain EVERY chunk, then surface the first failure in
         # submission order (matches the inline path's raise point); a

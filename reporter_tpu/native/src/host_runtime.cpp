@@ -17,8 +17,11 @@
 #include <array>
 #include <atomic>
 #include <cfenv>
+#include <chrono>
 #include <cmath>
 #include <cstdint>
+#include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <mutex>
 #include <queue>
@@ -233,11 +236,16 @@ struct Cand {
 };
 
 // per-thread scratch for candidate search (seen is n_edges bytes; reused
-// across points so the clear is O(|touched|), not O(E))
+// across points so the clear is O(|touched|), not O(E)). Consecutive
+// probe points are metres apart while cells are ~75 m, so the 3x3 cell
+// neighborhood usually repeats point-to-point: the deduped neighborhood
+// edge list is cached and reused until the centre cell (or reach)
+// changes, skipping the 9 hash lookups + dedup on most points.
 struct CandScratch {
   std::vector<Cand> cands;
   std::vector<char> seen;
-  std::vector<int32_t> seen_list;
+  std::vector<int32_t> nbr_edges;  // deduped; doubles as the seen-clear list
+  int64_t nbr_ci = INT64_MIN, nbr_cj = INT64_MIN, nbr_reach = -1;
   explicit CandScratch(int64_t n_edges) : seen(n_edges, 0) {}
 };
 
@@ -250,39 +258,47 @@ void candidates_for_point(const Graph* g, double x, double y, int32_t k,
   const double cell = g->cell;
   const int64_t reach = static_cast<int64_t>(std::ceil(radius / cell));
   s.cands.clear();
-  for (int32_t e : s.seen_list) s.seen[e] = 0;
-  s.seen_list.clear();
   const int64_t ci = static_cast<int64_t>(std::floor(x / cell));
   const int64_t cj = static_cast<int64_t>(std::floor(y / cell));
-  for (int64_t i = ci - reach; i <= ci + reach; ++i) {
-    for (int64_t j = cj - reach; j <= cj + reach; ++j) {
-      auto it = g->cells.find(Graph::cell_key(i, j));
-      if (it == g->cells.end()) continue;
-      for (int32_t e : it->second) {
-        if (s.seen[e]) continue;
-        s.seen[e] = 1;
-        s.seen_list.push_back(e);
-        const double ax = g->node_x[g->edge_start[e]];
-        const double ay = g->node_y[g->edge_start[e]];
-        const double bx = g->node_x[g->edge_end[e]];
-        const double by = g->node_y[g->edge_end[e]];
-        const double dx = bx - ax, dy = by - ay;
-        const double len2 = std::max(dx * dx + dy * dy, 1e-9);
-        double f = ((x - ax) * dx + (y - ay) * dy) / len2;
-        f = std::min(1.0, std::max(0.0, f));
-        const double qx = ax + f * dx, qy = ay + f * dy;
-        // cheap squared-distance prefilter (with ulp slack) so the exact
-        // but slow hypot — which must match numpy's np.hypot for
-        // tie-order parity (graph/spatial.py:125) — only runs for edges
-        // actually near the point
-        const double ex = x - qx, ey = y - qy;
-        if (ex * ex + ey * ey > radius * radius * 1.0000001) continue;
-        const double d = std::hypot(ex, ey);
-        if (d <= radius) {
-          s.cands.push_back({d, e, static_cast<float>(f * g->edge_len[e]),
-                             static_cast<float>(qx), static_cast<float>(qy)});
+  if (ci != s.nbr_ci || cj != s.nbr_cj || reach != s.nbr_reach) {
+    // rebuild the deduped neighborhood edge list for this centre cell
+    s.nbr_ci = ci;
+    s.nbr_cj = cj;
+    s.nbr_reach = reach;
+    for (int32_t e : s.nbr_edges) s.seen[e] = 0;
+    s.nbr_edges.clear();
+    for (int64_t i = ci - reach; i <= ci + reach; ++i) {
+      for (int64_t j = cj - reach; j <= cj + reach; ++j) {
+        auto it = g->cells.find(Graph::cell_key(i, j));
+        if (it == g->cells.end()) continue;
+        for (int32_t e : it->second) {
+          if (s.seen[e]) continue;
+          s.seen[e] = 1;
+          s.nbr_edges.push_back(e);
         }
       }
+    }
+  }
+  for (int32_t e : s.nbr_edges) {
+    const double ax = g->node_x[g->edge_start[e]];
+    const double ay = g->node_y[g->edge_start[e]];
+    const double bx = g->node_x[g->edge_end[e]];
+    const double by = g->node_y[g->edge_end[e]];
+    const double dx = bx - ax, dy = by - ay;
+    const double len2 = std::max(dx * dx + dy * dy, 1e-9);
+    double f = ((x - ax) * dx + (y - ay) * dy) / len2;
+    f = std::min(1.0, std::max(0.0, f));
+    const double qx = ax + f * dx, qy = ay + f * dy;
+    // cheap squared-distance prefilter (with ulp slack) so the exact
+    // but slow hypot — which must match numpy's np.hypot for
+    // tie-order parity (graph/spatial.py:125) — only runs for edges
+    // actually near the point
+    const double ex = x - qx, ey = y - qy;
+    if (ex * ex + ey * ey > radius * radius * 1.0000001) continue;
+    const double d = std::hypot(ex, ey);
+    if (d <= radius) {
+      s.cands.push_back({d, e, static_cast<float>(f * g->edge_len[e]),
+                         static_cast<float>(qx), static_cast<float>(qy)});
     }
   }
   const int32_t n = static_cast<int32_t>(
@@ -570,6 +586,17 @@ void rt_prepare_batch(void* handle, int64_t n_traces, const int64_t* pt_off,
     }
   };
 
+  // env-gated phase attribution (REPORTER_TPU_PREP_TIMINGS=1): ns per
+  // phase summed across worker threads, one stderr line per call — the
+  // only way to see inside the ctypes boundary without a profiler in
+  // the image. Off: one predictable branch per phase per trace.
+  static const bool timings = [] {
+    const char* v = std::getenv("REPORTER_TPU_PREP_TIMINGS");
+    return v != nullptr && v[0] != '\0' && v[0] != '0';
+  }();
+  using clk = std::chrono::steady_clock;
+  std::atomic<int64_t> ns_cand{0}, ns_select{0}, ns_route{0};
+
   auto prepare_one = [&](int64_t b, CandScratch& scratch,
                          std::vector<int32_t>& edge_raw,
                          std::vector<float>& dist_raw,
@@ -589,6 +616,8 @@ void rt_prepare_batch(void* handle, int64_t n_traces, const int64_t* pt_off,
     out_dwell[b] = 0.0f;
     if (n_raw <= 0) return;
 
+    clk::time_point tp;
+    if (timings) tp = clk::now();
     // candidates for every raw point (projection inline)
     edge_raw.resize(n_raw * K);
     dist_raw.resize(n_raw * K);
@@ -599,6 +628,11 @@ void rt_prepare_batch(void* handle, int64_t n_traces, const int64_t* pt_off,
       candidates_for_point(g, x, y, K, search_radius, scratch,
                            edge_raw.data() + p * K, dist_raw.data() + p * K,
                            off_raw.data() + p * K, nullptr, nullptr);
+    }
+    if (timings) {
+      const auto t2 = clk::now();
+      ns_cand += (t2 - tp).count();
+      tp = t2;
     }
 
     // kept selection: drop candidate-less points and jitter points within
@@ -679,6 +713,11 @@ void rt_prepare_batch(void* handle, int64_t n_traces, const int64_t* pt_off,
       }
     }
 
+    if (timings) {
+      const auto t2 = clk::now();
+      ns_select += (t2 - tp).count();
+      tp = t2;
+    }
     // route matrices between consecutive kept candidate rows; dt from the
     // kept points' probe times feeds the time-admissibility bound
     const bool have_dt = time_factor > 0 && n > 1;
@@ -698,6 +737,7 @@ void rt_prepare_batch(void* handle, int64_t n_traces, const int64_t* pt_off,
           local_max = row[q];
     }
     bump_max(local_max);
+    if (timings) ns_route += (clk::now() - tp).count();
   };
 
   int32_t workers = n_threads > 0
@@ -713,6 +753,12 @@ void rt_prepare_batch(void* handle, int64_t n_traces, const int64_t* pt_off,
     for (int64_t b = 0; b < n_traces; ++b)
       prepare_one(b, scratch, edge_raw, dist_raw, off_raw, kept);
     *out_max_finite = max_finite.load();
+    if (timings)
+      std::fprintf(stderr,
+                   "[prep_timings] traces=%lld candidates=%.3fms "
+                   "select_pack=%.3fms routes=%.3fms (one thread)\n",
+                   static_cast<long long>(n_traces), ns_cand.load() / 1e6,
+                   ns_select.load() / 1e6, ns_route.load() / 1e6);
     return;
   }
   std::atomic<int64_t> next{0};
@@ -732,6 +778,12 @@ void rt_prepare_batch(void* handle, int64_t n_traces, const int64_t* pt_off,
   }
   for (auto& th : pool) th.join();
   *out_max_finite = max_finite.load();
+  if (timings)
+    std::fprintf(stderr,
+                 "[prep_timings] traces=%lld candidates=%.3fms "
+                 "select_pack=%.3fms routes=%.3fms (thread-summed)\n",
+                 static_cast<long long>(n_traces), ns_cand.load() / 1e6,
+                 ns_select.load() / 1e6, ns_route.load() / 1e6);
 }
 
 // f32 -> f16 (IEEE half) bulk conversion for the wire tensors

@@ -346,3 +346,31 @@ class TestDevicePipeline:
             m.match_many(bad)
         after = m.match_many(good)
         assert all(r and r["segments"] for r in after)
+
+    def test_prep_failure_with_futures_in_flight(self, city, monkeypatch):
+        """The quiesce path with lane futures actually in flight: on the
+        native path a malformed trace raises before any submit (the
+        length bucketing walks all traces first), so inject the failure
+        into prep of a LATER chunk instead — earlier chunks are already
+        on the lanes when it propagates."""
+        import reporter_tpu.matcher.matcher as mod
+
+        monkeypatch.setenv("REPORTER_TPU_DECODE_CHUNK", "2")
+        m = SegmentMatcher(net=city)
+        reqs = self._reqs(city)
+        calls = {"n": 0}
+        real = mod.prepare_batch
+
+        def flaky(*a, **kw):
+            calls["n"] += 1
+            if calls["n"] == 2:
+                raise RuntimeError("prep exploded")
+            return real(*a, **kw)
+
+        monkeypatch.setattr(mod, "prepare_batch", flaky)
+        with pytest.raises(RuntimeError, match="prep exploded"):
+            m.match_many(reqs)
+        assert calls["n"] == 2, "failure must hit with a chunk in flight"
+        monkeypatch.setattr(mod, "prepare_batch", real)
+        after = m.match_many(reqs)
+        assert all(r and r["segments"] for r in after)
