@@ -43,12 +43,17 @@ class MatchParams:
 
     def with_options(self, options: dict) -> "MatchParams":
         """Apply per-request ``match_options`` overrides by reference name
-        (reference: generate_test_trace.py:45-52)."""
+        (reference: generate_test_trace.py:45-52).
+
+        Returns ``self`` when every override already equals the current
+        value — the common case (e.g. mode=auto on every request), and
+        what lets match_many group such traces into one prep/decode batch
+        without building 512 identical frozen dataclasses per call."""
         fields = {}
         for key in ("mode", "sigma_z", "beta", "breakage_distance",
                     "search_radius", "turn_penalty_factor", "gps_accuracy",
                     "max_route_distance_factor", "max_route_time_factor"):
-            if key in options:
+            if key in options and options[key] != getattr(self, key):
                 fields[key] = options[key]
         return replace(self, **fields) if fields else self
 

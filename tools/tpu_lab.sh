@@ -1,0 +1,88 @@
+#!/bin/bash
+# Tunnel watcher + TPU measurement battery (developer tool).
+#
+# The axon chip tunnel in this environment is intermittent; this script
+# polls until the chip answers, then runs, in order:
+#   1. microbench: tiny-jit RTT, h2d bandwidth at two sizes, d2h RTT
+#      -> distinguishes per-call latency from bandwidth as the device-
+#         chain bottleneck (pre-pipeline hardware run: 84 ms device
+#         chain per 512 traces, composition unknown)
+#   2. bench.py default (pipelined) -> the headline number
+#   3. REPORTER_TPU_DECODE_CHUNK sweep (64/256/512; fewer repeats)
+#   4. REPORTER_TPU_WIRE=f32 leg: doubles wire bytes; a large drop
+#      means bandwidth-bound, no drop means RTT-bound
+# Results land in tpu_lab_results/ as timestamped JSON/logs.
+set -u
+cd "$(dirname "$0")/.."
+OUT=tpu_lab_results
+mkdir -p "$OUT"
+STAMP=$(date -u +%Y%m%dT%H%M%SZ)
+LOG="$OUT/lab_$STAMP.log"
+MAX_POLLS=${TPU_LAB_MAX_POLLS:-120}          # x interval = watch window
+POLL_INTERVAL=${TPU_LAB_POLL_INTERVAL:-300}  # seconds
+
+probe() {
+  timeout 75 python -c "import jax; d=jax.devices(); assert d[0].platform=='tpu', d" \
+    >/dev/null 2>&1
+}
+
+echo "[lab $STAMP] watching for the chip tunnel" | tee -a "$LOG"
+for ((i = 1; i <= MAX_POLLS; i++)); do
+  if probe; then
+    echo "[lab] tunnel up on poll $i ($(date -u +%H:%M:%SZ))" | tee -a "$LOG"
+    break
+  fi
+  if ((i == MAX_POLLS)); then
+    echo "[lab] window expired without a tunnel" | tee -a "$LOG"
+    exit 1
+  fi
+  sleep "$POLL_INTERVAL"
+done
+
+run() { # name, env pairs..., then "--"
+  local name=$1
+  shift
+  echo "[lab] run: $name" | tee -a "$LOG"
+  env "$@" timeout 1200 python bench.py 2>>"$LOG" |
+    tail -1 >"$OUT/bench_${name}_$STAMP.json" ||
+    echo "[lab] $name failed rc=$?" | tee -a "$LOG"
+}
+
+# 1. microbench (own interpreter; bounded)
+timeout 600 python - >"$OUT/micro_$STAMP.json" 2>>"$LOG" <<'EOF'
+import json, time
+import numpy as np
+import jax, jax.numpy as jnp
+
+def best(f, n=8):
+    ts = []
+    for _ in range(n):
+        t0 = time.perf_counter(); f(); ts.append(time.perf_counter() - t0)
+    return {"best_ms": round(min(ts) * 1e3, 3),
+            "median_ms": round(sorted(ts)[n // 2] * 1e3, 3)}
+
+out = {"platform": jax.devices()[0].platform}
+f = jax.jit(lambda x: x + 1)
+x = jnp.ones((8,), jnp.float32)
+f(x).block_until_ready()
+out["tiny_jit_rtt"] = best(lambda: f(x).block_until_ready())
+a1 = np.ones((512, 64, 8, 8), np.float16)   # 4 MB: one route_m chunk x4
+a2 = np.ones((2048, 64, 8, 8), np.float16)  # 16 MB
+out["h2d_4mb"] = best(lambda: jax.device_put(a1).block_until_ready())
+out["h2d_16mb"] = best(lambda: jax.device_put(a2).block_until_ready())
+g = jax.jit(lambda x: jnp.argmax(x, -1).astype(jnp.int32))
+r = g(jnp.ones((512, 64, 8), jnp.float32)); r.block_until_ready()
+out["d2h_128kb"] = best(lambda: np.asarray(r))
+print(json.dumps(out))
+EOF
+echo "[lab] micro done" | tee -a "$LOG"
+
+# 2-4. bench legs (each own interpreter; probe diagnostics inside)
+run default
+run chunk64 REPORTER_TPU_DECODE_CHUNK=64 BENCH_REPEATS=3
+run chunk256 REPORTER_TPU_DECODE_CHUNK=256 BENCH_REPEATS=3
+run chunk512 REPORTER_TPU_DECODE_CHUNK=512 BENCH_REPEATS=3
+run wire_f32 REPORTER_TPU_WIRE=f32 BENCH_REPEATS=3 BENCH_PALLAS=0
+run nopipe REPORTER_TPU_PIPELINE=0 BENCH_REPEATS=3 BENCH_PALLAS=0
+echo "[lab] battery complete" | tee -a "$LOG"
+ls -la "$OUT" | tee -a "$LOG"
