@@ -39,6 +39,7 @@ ENV_PLATFORM = "REPORTER_TPU_PLATFORM"          # cpu | accel | auto
 ENV_VIRTUAL_DEVICES = "REPORTER_TPU_VIRTUAL_DEVICES"
 ENV_PROBE_TIMEOUT = "REPORTER_TPU_PROBE_TIMEOUT_S"  # default 90
 ENV_PROBE_TRIES = "REPORTER_TPU_PROBE_TRIES"        # default 2
+ENV_COMPILE_CACHE = "REPORTER_TPU_COMPILE_CACHE"    # dir | "0" to disable
 _DEVICE_COUNT_FLAG = "xla_force_host_platform_device_count"
 
 _decided: str | None = None  # this process's platform decision, once made
@@ -66,6 +67,43 @@ def _env_int(name: str, default: int) -> int:
 def _backends_initialized():
     from jax._src import xla_bridge
     return bool(getattr(xla_bridge, "_backends", None))
+
+
+def enable_compile_cache() -> None:
+    """Point JAX at a persistent on-disk compilation cache.
+
+    TPU compiles run 20-40 s per (shape, backend) and this framework
+    spans several short-lived processes per run (probe children, bench
+    legs, pipeline stage fan-out, service restarts) — without a
+    persistent cache every one of them recompiles the same bucket
+    shapes. ``REPORTER_TPU_COMPILE_CACHE`` names the directory ("0"
+    disables); default ~/.cache/reporter_tpu/xla. Safe to call
+    repeatedly and before/after backend init; never raises (an
+    unwritable cache dir just means cold compiles, and jax logs it).
+    """
+    val = os.environ.get(ENV_COMPILE_CACHE, "").strip()
+    if val.lower() in ("0", "off", "false", "none"):
+        return
+    path = val or os.path.join(
+        os.path.expanduser("~"), ".cache", "reporter_tpu", "xla")
+    try:
+        import jax
+
+        # an operator's native JAX cache configuration wins: only fill
+        # the gap when neither the standard env var nor a programmatic
+        # jax_compilation_cache_dir is already set
+        if os.environ.get("JAX_COMPILATION_CACHE_DIR") or \
+                jax.config.jax_compilation_cache_dir:
+            return
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        # cache everything that took meaningful compile time; the
+        # default 1s floor skips exactly the small shapes a micro-
+        # batching service churns through
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          0.1)
+    except Exception as e:  # pragma: no cover - best-effort cache
+        log.info("compilation cache unavailable (%s)", e)
 
 
 def force_virtual_cpu(n_devices: int | None = None) -> None:
@@ -117,6 +155,7 @@ def force_virtual_cpu(n_devices: int | None = None) -> None:
                 f"CPU backend already initialised with {len(jax.devices())} "
                 f"devices; {n_devices} requested — the device-count flag "
                 "only takes effect before the first backend init")
+    enable_compile_cache()
     _decided = "cpu"
 
 
@@ -194,6 +233,7 @@ def ensure_backend(prefer: str | None = None,
     global _decided
     if _decided is not None:
         return _decided
+    enable_compile_cache()
 
     # probe patience is env-tunable (a flaky chip tunnel day should be a
     # config change, not a code change); explicit args still win

@@ -396,5 +396,7 @@ class TestDevicePipeline:
             for _ in range(3):  # a few interleavings
                 fa = pool.submit(m.match_many, reqs_a)
                 fb = pool.submit(m.match_many, reqs_b)
-                assert fa.result() == want_a
-                assert fb.result() == want_b
+                # bounded waits: a lane deadlock must FAIL this test,
+                # not hang the suite until a job-level kill
+                assert fa.result(timeout=120) == want_a
+                assert fb.result(timeout=120) == want_b
