@@ -186,7 +186,9 @@ def main():
     # (only if reachable) pipelined child -> ensure_backend init.
     probe_pipelined = None
     forced = (os.environ.get(rt.ENV_PLATFORM) or "auto").lower()
-    pipeline_unset = os.environ.get("REPORTER_TPU_PIPELINE") is None
+    # falsy (unset OR empty) matches pipeline_enabled()'s own parsing,
+    # so the gate and the matcher can't disagree about "" meaning auto
+    pipeline_unset = not os.environ.get("REPORTER_TPU_PIPELINE", "").strip()
     if forced != "cpu" and pipeline_unset \
             and rt.accelerator_available(tries=1):
         ok, probe_pipelined = _probe_pipelined_accel(

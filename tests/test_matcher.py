@@ -400,3 +400,23 @@ class TestDevicePipeline:
                 # not hang the suite until a job-level kill
                 assert fa.result(timeout=120) == want_a
                 assert fb.result(timeout=120) == want_b
+
+    def test_pipeline_auto_default_is_platform_aware(self, monkeypatch):
+        """Env unset -> the default follows the host: ON with multiple
+        cores (something to overlap), OFF on a single-core CPU-only
+        host (thread hops are pure loss there). Empty string counts as
+        unset, matching bench.py's hardware-gate parsing."""
+        import os as os_mod
+
+        from reporter_tpu.matcher.matcher import pipeline_enabled
+
+        monkeypatch.delenv("REPORTER_TPU_PIPELINE", raising=False)
+        monkeypatch.setattr(os_mod, "cpu_count", lambda: 8)
+        assert pipeline_enabled() is True
+        monkeypatch.setattr(os_mod, "cpu_count", lambda: 1)
+        # tests run on the CPU backend (conftest pins it)
+        assert pipeline_enabled() is False
+        monkeypatch.setenv("REPORTER_TPU_PIPELINE", "")
+        assert pipeline_enabled() is False  # "" == auto, not forced-on
+        monkeypatch.setenv("REPORTER_TPU_PIPELINE", "1")
+        assert pipeline_enabled() is True
