@@ -38,17 +38,18 @@ _global_config: dict = {}
 def _decode_chunk() -> int:
     """Traces per decode dispatch. REPORTER_TPU_DECODE_CHUNK forces it;
     the default follows the pipeline mode: 128 when the device lanes
-    are on (chunks ARE the overlap granularity), 1024 when inline —
-    chunking buys nothing without lanes, and fewer dispatches are a
-    measured +17% end-to-end on a single-core host (1024 caps a
-    chunk's route_m at 32 MB f32)."""
+    are on (chunks ARE the overlap granularity), 512 when inline —
+    chunking buys nothing without lanes, so fewer dispatches win (+17%
+    measured on one core at 512 vs 128) until per-chunk tensors
+    (route_m: 16 MB f32 at 512) outgrow cache and memory bandwidth
+    takes it back (1024-row chunks measured ~10% SLOWER than 512)."""
     val = os.environ.get("REPORTER_TPU_DECODE_CHUNK", "").strip()
     if val:
         try:
             return max(1, int(val))
         except ValueError:
             pass
-    return 128 if pipeline_enabled() else 1024
+    return 128 if pipeline_enabled() else 512
 
 
 def _prep_workers() -> int:
