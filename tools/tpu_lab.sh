@@ -43,7 +43,7 @@ run() { # name, env pairs..., then "--"
   local name=$1
   shift
   echo "[lab] run: $name" | tee -a "$LOG"
-  env "$@" timeout 1200 python bench.py 2>>"$LOG" |
+  env "$@" timeout 1800 python bench.py 2>>"$LOG" |
     tail -1 >"$OUT/bench_${name}_$STAMP.json" ||
     echo "[lab] $name failed rc=$?" | tee -a "$LOG"
 }
