@@ -105,6 +105,8 @@ def _probe_pipelined_accel(timeout_s):
     the child cannot vacuously pass the probe."""
     import subprocess
     code = (
+        "from reporter_tpu.utils.runtime import enable_compile_cache\n"
+        "enable_compile_cache()  # share the accel AOT cache with the run\n"
         "import jax\n"
         "assert jax.devices()[0].platform != 'cpu', 'child on cpu'\n"
         "import numpy as np\n"

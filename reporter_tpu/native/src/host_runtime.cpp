@@ -335,12 +335,16 @@ void candidates_for_point(const Graph* g, double x, double y, int32_t k,
 //            have_dt && time_factor > 0 && dt > 0).
 // turn_penalty_factor adds meters for the heading change between the two
 // candidate edges: factor * 0.5 * (1 - cos(theta)).
-void route_step(Graph* g, const int32_t* ea_row, const float* oa_row,
-                const int32_t* eb_row, const float* ob_row, int32_t K,
-                float gc_t, double dt_t, bool have_dt, double factor,
-                double min_bound, double backward_tol, double time_factor,
-                double min_time_bound, double turn_penalty_factor,
-                float* out) {
+// Returns the largest finite distance written (0 when none): the wire-
+// dtype decision needs the batch max, and computing it here — while the
+// values are in registers — replaces a second cold pass over the 16 MB
+// route tensor per chunk.
+float route_step(Graph* g, const int32_t* ea_row, const float* oa_row,
+                 const int32_t* eb_row, const float* ob_row, int32_t K,
+                 float gc_t, double dt_t, bool have_dt, double factor,
+                 double min_bound, double backward_tol, double time_factor,
+                 double min_time_bound, double turn_penalty_factor,
+                 float* out) {
   const float bound = static_cast<float>(
       std::max(min_bound, factor * static_cast<double>(gc_t)));
   // min_time_bound floors the cap the way min_bound floors the distance
@@ -351,6 +355,7 @@ void route_step(Graph* g, const int32_t* ea_row, const float* oa_row,
       (have_dt && time_factor > 0 && dt_t > 0)
           ? static_cast<float>(std::max(min_time_bound, time_factor * dt_t))
           : -1.0f;  // no bound
+  float mx = 0.0f;
   for (int32_t i = 0; i < K; ++i) {
     const int32_t ea = ea_row[i];
     float* row = out + static_cast<int64_t>(i) * K;
@@ -375,9 +380,12 @@ void route_step(Graph* g, const int32_t* ea_row, const float* oa_row,
       }
       const float ob = ob_row[j];
       if (eb == ea && ob >= oa) {
-        row[j] = (time_cap >= 0 && g->edge_secs(ea, ob - oa) > time_cap)
-                     ? kUnreachable
-                     : ob - oa;
+        if (time_cap >= 0 && g->edge_secs(ea, ob - oa) > time_cap) {
+          row[j] = kUnreachable;
+        } else {
+          row[j] = ob - oa;
+          if (ob - oa > mx) mx = ob - oa;
+        }
         continue;
       }
       // forgive small apparent backward movement on the same directed
@@ -413,8 +421,10 @@ void route_step(Graph* g, const int32_t* ea_row, const float* oa_row,
         d += static_cast<float>(turn_penalty_factor) * 0.5f * (1.0f - cos_th);
       }
       row[j] = d;
+      if (d > mx) mx = d;
     }
   }
+  return mx;
 }
 
 // equirectangular distance in meters, matching core/geo.py exactly
@@ -702,6 +712,7 @@ void rt_prepare_batch(void* handle, int64_t n_traces, const int64_t* pt_off,
         const double gc = equirect_m(lat[p0 + pp], lon[p0 + pp],
                                      lat[p0 + p], lon[p0 + p]);
         gc_b[t - 1] = static_cast<float>(gc);
+        if (gc_b[t - 1] > local_max) local_max = gc_b[t - 1];
         // compare the FLOAT32 gc, as batchpad.prepare_trace does (it
         // casts gc to f32 before the breakage test) — a gap within one
         // f32 ulp of the threshold must split identically on both paths
@@ -724,17 +735,12 @@ void rt_prepare_batch(void* handle, int64_t n_traces, const int64_t* pt_off,
     for (int32_t t = 0; t + 1 < n; ++t) {
       const double dt_t =
           have_dt ? times[p0 + kept[t + 1]] - times[p0 + kept[t]] : 0.0;
-      route_step(g, edge_b + t * K, off_b + t * K, edge_b + (t + 1) * K,
-                 off_b + (t + 1) * K, K, gc_b[t], dt_t, have_dt, factor,
-                 min_bound, backward_tol, time_factor, min_time_bound,
-                 turn_penalty_factor, route_b + static_cast<int64_t>(t) * K * K);
-    }
-    for (int32_t t = 0; t + 1 < n; ++t) {
-      if (gc_b[t] > local_max) local_max = gc_b[t];
-      const float* row = route_b + static_cast<int64_t>(t) * K * K;
-      for (int32_t q = 0; q < K * K; ++q)
-        if (row[q] < kUnreachable / 2 && row[q] > local_max)
-          local_max = row[q];
+      const float step_max = route_step(
+          g, edge_b + t * K, off_b + t * K, edge_b + (t + 1) * K,
+          off_b + (t + 1) * K, K, gc_b[t], dt_t, have_dt, factor,
+          min_bound, backward_tol, time_factor, min_time_bound,
+          turn_penalty_factor, route_b + static_cast<int64_t>(t) * K * K);
+      if (step_max > local_max) local_max = step_max;
     }
     bump_max(local_max);
     if (timings) ns_route += (clk::now() - tp).count();

@@ -80,6 +80,13 @@ def enable_compile_cache() -> None:
     disables); default ~/.cache/reporter_tpu/xla. Safe to call
     repeatedly and before/after backend init; never raises (an
     unwritable cache dir just means cold compiles, and jax logs it).
+
+    Deliberately NOT wired into the CPU paths: XLA:CPU persists AOT
+    machine code whose feature lists are machine-specific (observed:
+    every cache load warns about compile-vs-host feature mismatch,
+    threatening SIGILL on heterogeneous hosts), and CPU compiles are
+    sub-second anyway. Accelerator branches of ensure_backend (and the
+    bench probe child) opt in explicitly.
     """
     val = os.environ.get(ENV_COMPILE_CACHE, "").strip()
     if val.lower() in ("0", "off", "false", "none"):
@@ -155,7 +162,6 @@ def force_virtual_cpu(n_devices: int | None = None) -> None:
                 f"CPU backend already initialised with {len(jax.devices())} "
                 f"devices; {n_devices} requested — the device-count flag "
                 "only takes effect before the first backend init")
-    enable_compile_cache()
     _decided = "cpu"
 
 
@@ -233,7 +239,6 @@ def ensure_backend(prefer: str | None = None,
     global _decided
     if _decided is not None:
         return _decided
-    enable_compile_cache()
 
     # probe patience is env-tunable (a flaky chip tunnel day should be a
     # config change, not a code change); explicit args still win
@@ -255,6 +260,7 @@ def ensure_backend(prefer: str | None = None,
         return "cpu"
 
     if choice in ("accel", "tpu"):
+        enable_compile_cache()
         import jax
         platform = jax.devices()[0].platform  # may block; caller opted in
         _decided = platform
@@ -270,9 +276,15 @@ def ensure_backend(prefer: str | None = None,
         _decided = jax.default_backend()
         if _decided == "cpu":
             os.environ[ENV_PLATFORM] = "cpu"
+        else:
+            # the cache config is documented safe after backend init; an
+            # accel backend that beat ensure_backend to initialisation
+            # must still get the persistent cache
+            enable_compile_cache()
         return _decided
 
     if accelerator_available(timeout_s=probe_timeout_s, tries=probe_tries):
+        enable_compile_cache()  # before the first accel compile
         try:
             platform = _init_accel_or_reexec(timeout_s=2 * probe_timeout_s)
         except RuntimeError as e:
