@@ -38,10 +38,17 @@ class BatchDispatcher:
     """
 
     def __init__(self, match_many: Callable[[Sequence[dict]], List[dict]],
-                 max_batch: int = 256, max_wait_ms: float = 20.0):
+                 max_batch: int = 256, max_wait_ms: float = 20.0,
+                 idle_grace_ms: float = 2.0):
         self._match_many = match_many
         self.max_batch = max_batch
         self.max_wait = max_wait_ms / 1000.0
+        # flush early once the queue has stayed empty this long: callers
+        # that were going to batch enqueue within a moment of each other,
+        # so an idle queue means waiting out the full max_wait would add
+        # latency without adding batch — max_wait stays the hard bound
+        # for a steady trickle of arrivals
+        self.idle_grace = min(idle_grace_ms / 1000.0, self.max_wait)
         self._queue: "queue.Queue[_Slot]" = queue.Queue()
         self._closed = False
         self._thread = threading.Thread(target=self._loop, daemon=True,
@@ -103,7 +110,9 @@ class BatchDispatcher:
 
     # ---- dispatch loop ---------------------------------------------------
     def _drain_batch(self) -> List[_Slot]:
-        """Block for the first trace, then collect until flush conditions."""
+        """Block for the first trace, then collect until a flush
+        condition: ``max_batch`` reached, ``max_wait`` elapsed since the
+        first trace, or the queue stayed empty for ``idle_grace``."""
         slots = [self._queue.get()]
         t0 = time.monotonic()
         while len(slots) < self.max_batch:
@@ -111,9 +120,10 @@ class BatchDispatcher:
             if remaining <= 0:
                 break
             try:
-                slots.append(self._queue.get(timeout=remaining))
+                slots.append(self._queue.get(
+                    timeout=min(remaining, self.idle_grace)))
             except queue.Empty:
-                break
+                break  # idle past the grace window — flush what we have
         return slots
 
     def _loop(self):

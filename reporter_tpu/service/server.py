@@ -53,7 +53,8 @@ class ReporterService:
             matcher.match_many,
             max_batch=max_batch or int(os.environ.get("MATCH_BATCH_MAX", 256)),
             max_wait_ms=max_wait_ms if max_wait_ms is not None else
-            float(os.environ.get("MATCH_BATCH_WAIT_MS", 20.0)))
+            float(os.environ.get("MATCH_BATCH_WAIT_MS", 20.0)),
+            idle_grace_ms=float(os.environ.get("MATCH_BATCH_GRACE_MS", 2.0)))
 
     def handle(self, trace: dict) -> tuple[int, str]:
         """Validate + match + report; (status, body). Validation messages
@@ -170,10 +171,19 @@ def make_handler(service: ReporterService):
 
 
 class BoundedThreadingHTTPServer(ThreadingHTTPServer):
-    """ThreadingHTTPServer with a cap on concurrent handler threads —
-    honours the reference's THREAD_POOL_COUNT / THREAD_POOL_MULTIPLIER
-    sizing (reference: reporter_service.py:37-40). Excess connections
-    queue in the listen backlog until a slot frees."""
+    """ThreadingHTTPServer with a cap on concurrent handler threads.
+
+    The reference sizes its pool at THREAD_POOL_COUNT or
+    THREAD_POOL_MULTIPLIER x cpus because each of its threads runs a
+    CPU-heavy C++ matcher (reference: reporter_service.py:37-40). Both
+    env knobs are honoured here, but the DEFAULT is a flat 64: in this
+    architecture handler threads only parse JSON and then *wait* on the
+    micro-batching dispatcher — they are IO-bound, and sizing them by
+    cpu count serialises requests on small hosts (measured on one core:
+    a pool of 1 turned every batch into a batch of ONE and added the
+    full dispatcher wait to every request — 44 req/s where the matcher
+    itself does thousands/s). Excess connections queue in the listen
+    backlog until a slot frees."""
 
     daemon_threads = True
     # accepts queue here while all pool slots are busy
@@ -181,10 +191,14 @@ class BoundedThreadingHTTPServer(ThreadingHTTPServer):
 
     def __init__(self, addr, handler, pool_size: int | None = None):
         if pool_size is None:
-            pool_size = int(os.environ.get(
-                "THREAD_POOL_COUNT",
-                int(os.environ.get("THREAD_POOL_MULTIPLIER", 1))
-                * multiprocessing.cpu_count()))
+            count = os.environ.get("THREAD_POOL_COUNT")
+            mult = os.environ.get("THREAD_POOL_MULTIPLIER")
+            if count:
+                pool_size = int(count)
+            elif mult:
+                pool_size = int(mult) * multiprocessing.cpu_count()
+            else:
+                pool_size = 64
         self._slots = threading.BoundedSemaphore(max(1, pool_size))
         super().__init__(addr, handler)
 

@@ -115,3 +115,78 @@ class TestService:
         assert body["counters"]["service.requests"] >= 1
         assert body["counters"]["dispatch.traces"] >= 1
         assert body["timers"]["dispatch.match_many"]["count"] >= 1
+
+
+class TestDispatchPolicy:
+    """Flush policy of the micro-batching dispatcher: idle-grace early
+    flush (latency) without giving up burst batching (throughput)."""
+
+    def test_idle_queue_flushes_before_max_wait(self):
+        """A lone request must not wait out the full max_wait: with an
+        idle queue the batch flushes after the grace window. This is
+        the single-handler pathology found under load: every request
+        paid the full 20 ms wait for co-batchers that could not exist."""
+        import time
+
+        from reporter_tpu.service.dispatch import BatchDispatcher
+
+        d = BatchDispatcher(lambda traces: [{"ok": True}] * len(traces),
+                            max_batch=64, max_wait_ms=500.0,
+                            idle_grace_ms=5.0)
+        try:
+            t0 = time.perf_counter()
+            out = d.submit({"uuid": "solo"})
+            elapsed = time.perf_counter() - t0
+            assert out == {"ok": True}
+            assert elapsed < 0.25, f"idle flush took {elapsed:.3f}s"
+        finally:
+            d.close()
+
+    def test_burst_still_batches(self):
+        """Traces already enqueued when the loop drains must land in one
+        batch regardless of the grace window."""
+        from reporter_tpu.service.dispatch import BatchDispatcher
+
+        sizes = []
+
+        def match_many(traces):
+            sizes.append(len(traces))
+            return [{"i": i} for i in range(len(traces))]
+
+        d = BatchDispatcher(match_many, max_batch=64, max_wait_ms=200.0,
+                            idle_grace_ms=5.0)
+        try:
+            out = d.submit_many([{"uuid": f"u{i}"} for i in range(16)])
+            assert len(out) == 16
+            assert max(sizes) >= 8, sizes  # the burst batched together
+        finally:
+            d.close()
+
+
+class TestPoolSizing:
+    def test_default_pool_not_cpu_bound(self, monkeypatch):
+        """Handler threads are IO-bound waiters; the default pool must
+        not collapse to cpu_count (=1 on small hosts, which serialises
+        requests and defeats micro-batching). Reference env knobs win."""
+        from reporter_tpu.service.server import BoundedThreadingHTTPServer
+        import socket as socket_mod
+
+        monkeypatch.delenv("THREAD_POOL_COUNT", raising=False)
+        monkeypatch.delenv("THREAD_POOL_MULTIPLIER", raising=False)
+        with socket_mod.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        srv = BoundedThreadingHTTPServer(("127.0.0.1", port), object)
+        try:
+            assert srv._slots._initial_value == 64
+        finally:
+            srv.server_close()
+        monkeypatch.setenv("THREAD_POOL_COUNT", "3")
+        with socket_mod.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        srv = BoundedThreadingHTTPServer(("127.0.0.1", port), object)
+        try:
+            assert srv._slots._initial_value == 3
+        finally:
+            srv.server_close()
