@@ -14,6 +14,9 @@ turns those into -inf transition scores.
 from __future__ import annotations
 
 import heapq
+import math
+import os
+from collections import OrderedDict
 from typing import Dict, Optional
 
 import numpy as np
@@ -22,6 +25,18 @@ from .network import RoadNetwork
 from .spatial import CandidateSet, PAD_EDGE
 
 UNREACHABLE = np.float32(1.0e9)
+
+# LRU capacities (env-tunable). Node entries hold whole bounded-Dijkstra
+# result dicts (big, few); pair entries are 3-tuples (tiny, many).
+_ENV_NODE_CAP = "REPORTER_TPU_ROUTE_CACHE_NODES"
+_ENV_PAIR_CAP = "REPORTER_TPU_ROUTE_CACHE_PAIRS"
+
+
+def _env_cap(name: str, default: int) -> int:
+    try:
+        return max(1, int(os.environ.get(name, "") or default))
+    except ValueError:
+        return default
 
 
 def _edge_secs(net: RoadNetwork, e: int, meters: float) -> float:
@@ -101,28 +116,112 @@ def shortest_path_edges(net: RoadNetwork, src_node: int, dst_node: int,
 
 
 class RouteCache:
-    """Caches bounded single-source Dijkstra results by (source node).
+    """Two-level LRU route cache, shared across batches and requests.
 
-    A cached entry is only reused when its bound covers the requested bound;
-    otherwise it is recomputed at the larger bound. Entries map
-    ``node -> (distance_m, travel_time_s)``.
+    Level 1 (``distances_from``) caches bounded single-source Dijkstra
+    result dicts by source node — a batch of traces over one city
+    amortises the searches. A cached entry is only reused when its bound
+    covers the requested bound; otherwise it is recomputed at the larger
+    bound. Entries map ``node -> (distance_m, travel_time_s)``.
+
+    Level 2 (``pair_get``/``pair_put``) caches the node-to-node route
+    kernel per ``(edge_from, edge_to)`` — the same urban edge pairs
+    recur on every batch and every service request, and the pair hit
+    skips not just the Dijkstra but the whole result-dict probe. The
+    cached value is the raw (bound, distance_m, travel_time_s) triple;
+    offset arithmetic, turn penalties and the time-admissibility check
+    are reapplied per query from the live dt, so a hit is bit-identical
+    to a recompute (pinned by tests/test_route_cache.py) and the key
+    deliberately does NOT include dt: the cached kernel is
+    dt-independent, and keying on it would only fragment the LRU across
+    sampling-gap buckets.
+
+    Both levels are LRU-bounded so a long-running service cannot grow
+    without bound; hit/miss counts feed utils.metrics via
+    ``flush_metrics`` (surfaced on the service /stats endpoint).
+
+    Concurrency: shared across threads under CPython's GIL. Each dict
+    operation is atomic, but a get can race a concurrent eviction, so
+    the LRU bookkeeping (``move_to_end``/``popitem``) tolerates the key
+    having vanished — a lost LRU bump or a double-evict costs a
+    redundant recompute, never corruption and never an exception (the
+    SegmentMatcher concurrent-Match contract).
     """
 
-    def __init__(self, net: RoadNetwork):
+    def __init__(self, net: RoadNetwork, max_nodes: Optional[int] = None,
+                 max_pairs: Optional[int] = None):
         self.net = net
-        self._cache: Dict[int, tuple] = {}  # node -> (bound, dist dict)
+        self._cache: "OrderedDict[int, tuple]" = OrderedDict()
+        self._pairs: "OrderedDict[tuple, tuple]" = OrderedDict()
+        self.max_nodes = max_nodes if max_nodes is not None \
+            else _env_cap(_ENV_NODE_CAP, 1 << 16)
+        self.max_pairs = max_pairs if max_pairs is not None \
+            else _env_cap(_ENV_PAIR_CAP, 1 << 20)
         self.hits = 0
         self.misses = 0
+        self.pair_hits = 0
+        self.pair_misses = 0
+        self._flushed = (0, 0, 0, 0)
+
+    @staticmethod
+    def _bump(lru: OrderedDict, key) -> None:
+        try:
+            lru.move_to_end(key)
+        except KeyError:  # concurrently evicted; the fetched value stands
+            pass
+
+    @staticmethod
+    def _evict(lru: OrderedDict, cap: int) -> None:
+        while len(lru) > cap:
+            try:
+                lru.popitem(last=False)
+            except KeyError:  # concurrent evictor got there first
+                break
 
     def distances_from(self, node: int, max_dist: float) -> Dict[int, tuple]:
         entry = self._cache.get(node)
         if entry is not None and entry[0] >= max_dist:
             self.hits += 1
+            self._bump(self._cache, node)
             return entry[1]
         self.misses += 1
         dist = _dijkstra_bounded(self.net, node, max_dist)
         self._cache[node] = (max_dist, dist)
+        self._bump(self._cache, node)
+        self._evict(self._cache, self.max_nodes)
         return dist
+
+    # ---- pair level ------------------------------------------------------
+    def pair_get(self, edge_a: int, edge_b: int):
+        """Cached (bound_m, node_dist_m, node_secs) for the general route
+        from edge_a's end node to edge_b's start node, or None. node_dist
+        is inf when the pair was unreachable within bound_m."""
+        got = self._pairs.get((edge_a, edge_b))
+        if got is not None:
+            self.pair_hits += 1
+            self._bump(self._pairs, (edge_a, edge_b))
+        else:
+            self.pair_misses += 1
+        return got
+
+    def pair_put(self, edge_a: int, edge_b: int,
+                 bound: float, node_dist: float, node_secs: float) -> None:
+        self._pairs[(edge_a, edge_b)] = (bound, node_dist, node_secs)
+        self._evict(self._pairs, self.max_pairs)
+
+    def flush_metrics(self) -> None:
+        """Publish counter deltas since the last flush to utils.metrics
+        (route.cache.* counters). Called once per prepared trace/batch —
+        per-pair metric increments would cost a lock op per (t, i, j)."""
+        from ..utils import metrics
+
+        now = (self.hits, self.misses, self.pair_hits, self.pair_misses)
+        names = ("route.cache.node_hits", "route.cache.node_misses",
+                 "route.cache.pair_hits", "route.cache.pair_misses")
+        for name, cur, old in zip(names, now, self._flushed):
+            if cur > old:
+                metrics.count(name, cur - old)
+        self._flushed = now
 
 
 def route_distance(net: RoadNetwork, edge_a: int, offset_a: float,
@@ -160,8 +259,24 @@ def route_distance(net: RoadNetwork, edge_a: int, offset_a: float,
         return float(UNREACHABLE)
     src = int(net.edge_end[edge_a])
     dst = int(net.edge_start[edge_b])
+    node_dt = None
     if cache is not None:
-        node_dt = cache.distances_from(src, max_dist - via).get(dst)
+        # pair level first: a bounded-Dijkstra dict entry is always the
+        # EXACT shortest distance (relaxation never inserts past the
+        # bound), so a cached finite pair is reusable at any query bound;
+        # a cached unreachable only proves unreachability up to the bound
+        # it was searched at
+        got = cache.pair_get(edge_a, edge_b)
+        sub = max_dist - via
+        if got is not None and math.isinf(got[1]) and got[0] < sub:
+            got = None  # unreachable verdict from a shallower search
+        if got is not None:
+            node_dt = None if math.isinf(got[1]) else (got[1], got[2])
+        else:
+            node_dt = cache.distances_from(src, sub).get(dst)
+            cache.pair_put(edge_a, edge_b, sub,
+                           node_dt[0] if node_dt is not None else math.inf,
+                           node_dt[1] if node_dt is not None else 0.0)
     else:
         node_dt = _dijkstra_bounded(net, src, max_dist - via).get(dst)
     # a reused cache entry may have been computed at a larger bound and
@@ -189,7 +304,7 @@ def candidate_route_matrices(net: RoadNetwork, cands: CandidateSet,
                              backward_tolerance_m: float = 0.0,
                              dt: Optional[np.ndarray] = None,
                              max_route_time_factor: float = 0.0,
-                             min_time_bound_s: float = 60.0,
+                             min_time_bound_s: float = 15.0,
                              turn_penalty_factor: float = 0.0) -> np.ndarray:
     """(T-1, K, K) route-distance tensor between consecutive candidates.
 
@@ -237,4 +352,5 @@ def candidate_route_matrices(net: RoadNetwork, cands: CandidateSet,
                     net, ea, oa, eb, ob, bound, cache,
                     backward_tolerance_m=backward_tolerance_m,
                     time_cap_s=time_cap, turn_penalty_m=penalty)
+    cache.flush_metrics()
     return out

@@ -74,23 +74,6 @@ class _Run:
         # queue_length is the slow tail measured from the segment end)
         self.queue_start: Optional[float] = None
 
-    def extend(self, idx: int, pos: float, time: float, cum: float, edge: int,
-               queue_threshold_kph: float):
-        dt = time - self.last_time
-        if dt > 0.0:
-            speed_kph = (pos - self.last_pos) / dt * 3.6
-            if speed_kph < queue_threshold_kph:
-                if self.queue_start is None:
-                    self.queue_start = self.last_pos
-            else:
-                self.queue_start = None
-        self.last_idx = idx
-        self.last_pos = pos
-        self.last_time = time
-        self.last_cum = cum
-        if self.edges[-1] != edge:
-            self.edges.append(edge)
-
     def queue_length(self, seg_len: float) -> int:
         if self.segment_id is None or self.queue_start is None \
                 or seg_len <= 0.0:
@@ -149,16 +132,6 @@ def assemble_segments(net: RoadNetwork, prepared, path: np.ndarray,
         steps = np.where(steps < UNREACHABLE / 2,
                          np.maximum(steps - penalty, 0.0), steps)
 
-    edges_l = edges.tolist()
-    pad_l = pad.tolist()
-    seg_ids_l = seg_ids.tolist()
-    seg_pos_l = seg_pos.tolist()
-    internal_l = internal.tolist()
-    kept_l = kept.tolist()
-    times_l = times_kept.tolist()
-    restart_l = restarts.tolist()
-    steps_l = steps.tolist()
-
     segments: List[dict] = []
 
     # a vehicle stalled at trace end emits points the jitter filter drops
@@ -172,43 +145,44 @@ def assemble_segments(net: RoadNetwork, prepared, path: np.ndarray,
     trailing_dwell_s = float(getattr(prepared, "trailing_jitter_dwell_s",
                                      0.0))
 
-    # walk chains of kept points, split at RESTART boundaries; excluded
-    # points BETWEEN runs are attributed to spans by the fix-up after the
-    # walk (dropped points inside one run's span need nothing)
-    chain: List[tuple] = []  # (orig_idx, edge, seg_id, seg_pos, time, cum, internal)
-
-    def flush_chain(final: bool = False):
-        if chain:
+    # chains of kept points, split at RESTART boundaries, decoded-pad
+    # points and unroutable decoded transitions; excluded points BETWEEN
+    # runs are attributed to spans by the fix-up after the walk (dropped
+    # points inside one run's span need nothing). The scan is a fixed set
+    # of array ops: a chain is a maximal run of consecutive non-pad
+    # points with no break flag, so boundaries fall out of one mask and
+    # each chain is a contiguous slice of the gathered columns.
+    nonpad_idx = np.flatnonzero(~pad)
+    if nonpad_idx.size:
+        break_before = np.ones(n, dtype=bool)
+        if n > 1:
+            break_before[1:] = (restarts[1:] | pad[:-1]
+                                | (steps >= UNREACHABLE / 2))
+        chain_pos = np.flatnonzero(break_before[nonpad_idx])
+        chain_lo = nonpad_idx[chain_pos]
+        chain_hi = np.r_[nonpad_idx[chain_pos[1:] - 1] + 1,
+                         nonpad_idx[-1] + 1]
+        # within-chain cumulative route position: sequential f64
+        # accumulation (np.cumsum), matching the scalar walk bit-for-bit;
+        # chains reset to 0 (only intra-chain differences are consumed)
+        steps64 = np.asarray(steps, dtype=np.float64)
+        last_chain = len(chain_lo) - 1
+        # the trailing dwell belongs to the chain still open at trace end
+        dwell_ok = int(nonpad_idx[-1]) == n - 1
+        for k in range(len(chain_lo)):
+            lo, hi = int(chain_lo[k]), int(chain_hi[k])
+            cum = np.zeros(hi - lo, dtype=np.float64)
+            if hi - lo > 1:
+                np.cumsum(steps64[lo:hi - 1], out=cum[1:])
+            final = k == last_chain and dwell_ok
             segments.extend(_chain_to_segments(
-                net, chain, queue_threshold_kph,
+                net,
+                (kept[lo:hi], edges[lo:hi], seg_ids[lo:hi],
+                 seg_pos[lo:hi], times_kept[lo:hi], cum, internal[lo:hi]),
+                queue_threshold_kph,
                 trailing_dwell_s=trailing_dwell_s if final else 0.0,
                 interpolation_distance_m=interpolation_distance_m,
                 backward_tolerance_m=backward_tolerance_m))
-        chain.clear()
-
-    cum = 0.0
-    prev_ok = False
-    for t in range(n):
-        if restart_l[t]:
-            flush_chain()
-            cum = 0.0
-            prev_ok = False
-        if pad_l[t]:
-            flush_chain()
-            prev_ok = False
-            continue
-        if prev_ok:
-            step = steps_l[t - 1]
-            if step >= UNREACHABLE / 2:
-                # decoder was forced through an unroutable pair; break here
-                flush_chain()
-                cum = 0.0
-            else:
-                cum += step
-        chain.append((kept_l[t], edges_l[t], seg_ids_l[t], seg_pos_l[t],
-                      times_l[t], cum, internal_l[t]))
-        prev_ok = True
-    flush_chain(final=True)
 
     # attribute the jitter points the HMM excluded: gap points between
     # runs join the FOLLOWING run (keeping the preceding run's end at
@@ -239,11 +213,15 @@ def assemble_segments(net: RoadNetwork, prepared, path: np.ndarray,
     return {"segments": segments, "mode": mode}
 
 
-def _chain_to_segments(net: RoadNetwork, chain: List[tuple],
+def _chain_to_segments(net: RoadNetwork, chain: tuple,
                        queue_threshold_kph: float = 10.0,
                        trailing_dwell_s: float = 0.0,
                        interpolation_distance_m: float = 10.0,
                        backward_tolerance_m: float = 25.0) -> List[dict]:
+    """``chain``: column arrays (idx, edge, seg_id, seg_pos, time, cum,
+    internal) for one contiguous chain of decoded points."""
+    idxs, edges_a, sids_raw, poss, times_a, cums, internals = chain
+    m = len(idxs)
     # a re-entry onto the same segment starts a new run — but apparent
     # backward movement within the matcher's backward tolerance is
     # along-track GPS noise (the same phenomenon route_distance prices as
@@ -251,22 +229,45 @@ def _chain_to_segments(net: RoadNetwork, chain: List[tuple],
     # shatters one traversal into several partial runs and loses the
     # complete-traversal report
     reentry_tol = max(_BOUNDARY_EPS, backward_tolerance_m)
-    # group the chain into runs of one segment (or one unassociated stretch)
+    # run boundaries in one vector pass: every negative segment id means
+    # "unassociated", so they collapse to one sentinel before comparing
+    sids = np.where(sids_raw < 0, np.int64(-1), sids_raw)
+    new_run = np.ones(m, dtype=bool)
+    if m > 1:
+        new_run[1:] = ((sids[1:] != sids[:-1])
+                       | (internals[1:] != internals[:-1])
+                       | ((sids[1:] >= 0)
+                          & (poss[1:] < poss[:-1] - reentry_tol)))
+    run_lo = np.flatnonzero(new_run)
+    run_hi = np.r_[run_lo[1:], m]
     runs: List[_Run] = []
-    for idx, edge, seg_id, seg_pos, time, cum, internal in chain:
-        sid = seg_id if seg_id >= 0 else None
-        same = (
-            runs
-            and runs[-1].segment_id == sid
-            and runs[-1].internal == internal
-            and not (sid is not None
-                     and seg_pos < runs[-1].last_pos - reentry_tol)
-        )
-        if same:
-            runs[-1].extend(idx, seg_pos, time, cum, edge,
-                            queue_threshold_kph)
-        else:
-            runs.append(_Run(sid, internal, idx, seg_pos, time, cum, edge))
+    for a, b in zip(run_lo.tolist(), run_hi.tolist()):
+        sid_v = int(sids[a])
+        r = _Run(sid_v if sid_v >= 0 else None, bool(internals[a]),
+                 int(idxs[a]), float(poss[a]), float(times_a[a]),
+                 float(cums[a]), int(edges_a[a]))
+        if b - a > 1:
+            r.last_idx = int(idxs[b - 1])
+            r.last_pos = float(poss[b - 1])
+            r.last_time = float(times_a[b - 1])
+            r.last_cum = float(cums[b - 1])
+            e = edges_a[a:b]
+            r.edges = e[np.r_[True, e[1:] != e[:-1]]].tolist()
+            # queue detection: the trailing maximal streak of slow
+            # intervals (dt > 0) anchors queue_start at the position
+            # where the streak began; any fast interval resets it
+            dts = times_a[a + 1:b] - times_a[a:b - 1]
+            act = dts > 0.0
+            with np.errstate(divide="ignore", invalid="ignore"):
+                speed = (poss[a + 1:b] - poss[a:b - 1]) / dts * 3.6
+            slow = act & (speed < queue_threshold_kph)
+            fast = act & ~slow
+            lf = np.flatnonzero(fast)
+            start_j = int(lf[-1]) + 1 if lf.size else 0
+            sl = np.flatnonzero(slow[start_j:])
+            if sl.size:
+                r.queue_start = float(poss[a + start_j + int(sl[0])])
+        runs.append(r)
 
     # trailing raw-point dwell (see assemble_segments): the dropped tail
     # stayed within interpolation_distance for dwell seconds — if even the

@@ -24,6 +24,7 @@ from typing import List, Sequence
 import numpy as np
 
 from ..core.geo import equirectangular_m
+from ..core.tracebatch import TraceBatch, points_to_columns
 from ..graph.network import RoadNetwork
 from ..graph.route import RouteCache, candidate_route_matrices, UNREACHABLE
 from ..graph.spatial import CandidateSet, SpatialGrid, PAD_EDGE, PAD_DIST
@@ -71,15 +72,33 @@ class PreparedTrace:
 
 def _select_kept(lat, lon, has_cands, interpolation_distance):
     """Indices of points that enter the HMM: drop candidate-less points and
-    points within ``interpolation_distance`` of the last kept point."""
-    kept = []
-    for i in range(len(lat)):
-        if not has_cands[i]:
+    points within ``interpolation_distance`` of the last kept point.
+
+    Vectorised common case: when every consecutive pair of candidate-
+    bearing points is at least the interpolation distance apart (a moving
+    vehicle — the overwhelming majority of traces), the anchor never
+    skips a point and the answer is one array op. The sequential scan
+    only runs from the first violation onward (a slow/stopped stretch),
+    where the moving-anchor semantics are irreducibly order-dependent.
+    """
+    has = np.asarray(has_cands, dtype=bool)
+    idx = np.flatnonzero(has)
+    if idx.size <= 1:
+        return idx.astype(np.int32)
+    lat = np.asarray(lat)
+    lon = np.asarray(lon)
+    gc = np.atleast_1d(equirectangular_m(lat[idx[:-1]], lon[idx[:-1]],
+                                         lat[idx[1:]], lon[idx[1:]]))
+    viol = np.flatnonzero(gc < interpolation_distance)
+    if viol.size == 0:
+        return idx.astype(np.int32)
+    j = int(viol[0])  # pairs before the first violation are all kept
+    kept = idx[:j + 1].tolist()
+    for i in idx[j + 1:].tolist():
+        gc_i = equirectangular_m(lat[kept[-1]], lon[kept[-1]],
+                                 lat[i], lon[i])
+        if gc_i < interpolation_distance:
             continue
-        if kept:
-            gc = equirectangular_m(lat[kept[-1]], lon[kept[-1]], lat[i], lon[i])
-            if gc < interpolation_distance:
-                continue
         kept.append(i)
     return np.asarray(kept, dtype=np.int32)
 
@@ -92,17 +111,53 @@ def prepare_trace(net: RoadNetwork, grid: SpatialGrid | None,
 
     ``runtime`` (reporter_tpu.native.NativeRuntime) supplies C++ candidate
     lookup and route matrices when available; the numpy ``grid`` + ``cache``
-    path is the fallback with identical semantics.
+    path is the fallback with identical semantics. ``points`` is a point-
+    dict sequence (converted to columns once, here at the edge).
     """
-    num_raw = len(points)
-    lat = np.array([p["lat"] for p in points], dtype=np.float64)
-    lon = np.array([p["lon"] for p in points], dtype=np.float64)
-    times = np.array([p["time"] for p in points], dtype=np.float64)
-    K = params.max_candidates
-
+    lat, lon, times, _acc = points_to_columns(points)
     lookup = runtime if runtime is not None else grid
-    all_cands = lookup.candidates(lat, lon, K, params.search_radius)
+    all_cands = lookup.candidates(lat, lon, params.max_candidates,
+                                  params.search_radius)
     has_cands = (all_cands.edge_ids != PAD_EDGE).any(axis=1)
+    return _prepare_from_candidates(net, lat, lon, times, all_cands,
+                                    has_cands, params, cache, runtime)
+
+
+def prepare_traces_numpy(net: RoadNetwork, grid: SpatialGrid,
+                         tb: TraceBatch, params: MatchParams,
+                         cache: RouteCache | None = None,
+                         ) -> List[PreparedTrace]:
+    """Whole-chunk numpy host prep (the fallback hot path): ONE vectorised
+    candidate search over every point of every trace in the chunk, then
+    per-trace route tensors through the shared cross-batch route cache.
+    Same per-trace semantics as :func:`prepare_trace` — the candidate
+    tensors sliced out of the batch lookup are identical to a per-trace
+    lookup because the grid query is a pure per-point function."""
+    K = params.max_candidates
+    all_c = grid.candidates(tb.lat, tb.lon, K, params.search_radius)
+    has_all = (all_c.edge_ids != PAD_EDGE).any(axis=1)
+    out = []
+    offsets = tb.offsets
+    for b in range(len(tb)):
+        lo, hi = int(offsets[b]), int(offsets[b + 1])
+        sub = CandidateSet(
+            edge_ids=all_c.edge_ids[lo:hi], dist_m=all_c.dist_m[lo:hi],
+            offset_m=all_c.offset_m[lo:hi], proj_x=all_c.proj_x[lo:hi],
+            proj_y=all_c.proj_y[lo:hi])
+        out.append(_prepare_from_candidates(
+            net, tb.lat[lo:hi], tb.lon[lo:hi], tb.time[lo:hi], sub,
+            has_all[lo:hi], params, cache, None))
+    return out
+
+
+def _prepare_from_candidates(net, lat, lon, times, all_cands, has_cands,
+                             params: MatchParams, cache, runtime
+                             ) -> PreparedTrace:
+    """Kept-point selection, route tensors, case codes and padding for one
+    trace whose candidate lookup already happened (shared by the
+    per-trace and whole-batch prep paths)."""
+    num_raw = len(lat)
+    K = params.max_candidates
     kept = _select_kept(lat, lon, has_cands, params.interpolation_distance)
     n = len(kept)
     T = bucket_length(max(n, 1))
@@ -164,11 +219,11 @@ def prepare_trace(net: RoadNetwork, grid: SpatialGrid | None,
     # case codes over kept points: RESTART at the first point and after
     # breakage-sized gaps; SKIP only in the padding tail
     case = np.full(T, SKIP, dtype=np.int32)
-    for t in range(n):
-        if t == 0 or gc[t - 1] > params.breakage_distance:
-            case[t] = RESTART
-        else:
-            case[t] = NORMAL
+    if n:
+        case[:n] = NORMAL
+        case[0] = RESTART
+        if n > 1:
+            case[1:n][gc[:n - 1] > params.breakage_distance] = RESTART
 
     # pad to bucket
     edge_ids = np.full((T, K), PAD_EDGE, dtype=np.int32)
@@ -257,27 +312,37 @@ def prepare_batch(runtime, traces_points: Sequence[Sequence[dict]],
     (reference: py/reporter_service.py:240) on the host side; BENCH_r03
     measured per-trace Python as the end-to-end ceiling.
 
-    ``traces_points``: one list of point dicts per trace. ``T``: the
-    padding bucket (all traces in a chunk share it — callers bucket by
-    raw length first). ``pad_rows`` >= B adds all-SKIP filler rows (mesh
-    divisibility / pow2 shape bounding). Float tensors ship on the f16
-    wire when every finite distance fits (same policy as pack_batches).
+    ``traces_points``: a columnar :class:`TraceBatch` (the zero-dict hot
+    path — flat coordinate arrays pass straight through to the native
+    call) or one list of point dicts per trace (converted here, once).
+    ``T``: the padding bucket (all traces in a chunk share it — callers
+    bucket by raw length first). ``pad_rows`` >= B adds all-SKIP filler
+    rows (mesh divisibility / pow2 shape bounding). Float tensors ship on
+    the f16 wire when every finite distance fits (same policy as
+    pack_batches).
 
     Returns a PaddedBatch whose ``traces`` are PreparedTrace *views* over
     the batch tensors (rows of the pre-cast f32 arrays), usable by
     assemble_segments unchanged.
     """
-    B = len(traces_points)
-    counts = [len(pts) for pts in traces_points]
-    pt_off = np.zeros(B + 1, dtype=np.int64)
-    np.cumsum(counts, out=pt_off[1:])
-    n_pts = int(pt_off[-1])
-    lat = np.fromiter((p["lat"] for pts in traces_points for p in pts),
-                      np.float64, n_pts)
-    lon = np.fromiter((p["lon"] for pts in traces_points for p in pts),
-                      np.float64, n_pts)
-    times = np.fromiter((p["time"] for pts in traces_points for p in pts),
-                        np.float64, n_pts)
+    if isinstance(traces_points, TraceBatch):
+        B = len(traces_points)
+        pt_off = traces_points.offsets
+        counts = np.diff(pt_off)
+        lat, lon, times = (traces_points.lat, traces_points.lon,
+                           traces_points.time)
+    else:
+        B = len(traces_points)
+        counts = [len(pts) for pts in traces_points]
+        pt_off = np.zeros(B + 1, dtype=np.int64)
+        np.cumsum(counts, out=pt_off[1:])
+        n_pts = int(pt_off[-1])
+        lat = np.fromiter((p["lat"] for pts in traces_points for p in pts),
+                          np.float64, n_pts)
+        lon = np.fromiter((p["lon"] for pts in traces_points for p in pts),
+                          np.float64, n_pts)
+        times = np.fromiter((p["time"] for pts in traces_points for p in pts),
+                            np.float64, n_pts)
 
     out = runtime.prepare_batch(
         pt_off, lat, lon, times, T, params.max_candidates,
@@ -298,7 +363,7 @@ def prepare_batch(runtime, traces_points: Sequence[Sequence[dict]],
         for b in range(B):
             nk = int(num_kept[b])
             views.append(PreparedTrace(
-                num_raw=counts[b], num_kept=nk, kept_idx=kept[b, :nk],
+                num_raw=int(counts[b]), num_kept=nk, kept_idx=kept[b, :nk],
                 times=times[pt_off[b]:pt_off[b + 1]],
                 edge_ids=edge_ids[b], dist_m=out["dist_m"][b],
                 offset_m=out["offset_m"][b],

@@ -21,13 +21,14 @@ from typing import List, Optional, Sequence
 
 import numpy as np
 
+from ..core.tracebatch import TraceBatch, as_trace_batch
 from ..graph.network import RoadNetwork
 from ..graph.route import RouteCache
 from ..graph.spatial import SpatialGrid
 from ..utils import metrics
 from .assemble import assemble_segments
-from .batchpad import (bucket_length, pack_batches, padded_batch_rows,
-                       prepare_batch, prepare_trace)
+from .batchpad import (LENGTH_BUCKETS, pack_batches, padded_batch_rows,
+                       prepare_batch, prepare_trace, prepare_traces_numpy)
 from .params import MatchParams
 
 # process-wide configuration, mirroring valhalla.Configure's module-level
@@ -38,18 +39,21 @@ _global_config: dict = {}
 def _decode_chunk() -> int:
     """Traces per decode dispatch. REPORTER_TPU_DECODE_CHUNK forces it;
     the default follows the pipeline mode: 128 when the device lanes
-    are on (chunks ARE the overlap granularity), 512 when inline —
-    chunking buys nothing without lanes, so fewer dispatches win (+17%
-    measured on one core at 512 vs 128) until per-chunk tensors
-    (route_m: 16 MB f32 at 512) outgrow cache and memory bandwidth
-    takes it back (1024-row chunks measured ~10% SLOWER than 512)."""
+    are on AND there is more than one core to overlap across (chunks
+    ARE the overlap granularity), 512 otherwise — chunking buys nothing
+    without real overlap, so fewer dispatches win (+17% measured on one
+    core at 512 vs 128) until per-chunk tensors (route_m: 16 MB f32 at
+    512) outgrow cache and memory bandwidth takes it back (1024-row
+    chunks measured ~10% SLOWER than 512)."""
     val = os.environ.get("REPORTER_TPU_DECODE_CHUNK", "").strip()
     if val:
         try:
             return max(1, int(val))
         except ValueError:
             pass
-    return 128 if pipeline_enabled() else 512
+    if pipeline_enabled() and (os.cpu_count() or 1) > 1:
+        return 128
+    return 512
 
 
 def _prep_workers() -> int:
@@ -83,30 +87,45 @@ def pipeline_enabled() -> bool:
     return jax.default_backend() != "cpu"
 
 
-def _format_runs(runs: dict, lo: int, hi: int, mode: str) -> dict:
-    """Native assembler run columns [lo, hi) -> the reference-schema match
-    dict (same keys/values as matcher.assemble.assemble_segments;
-    reference: README.md "Reporter Output").
+def _runs_as_lists(runs: dict) -> dict:
+    """Convert the native assembler's run columns to Python lists ONCE
+    per decoded batch. The old per-trace slice-and-tolist paid ~4k tiny
+    tolist calls per 512-trace chunk (8 columns x B slices, each with
+    fixed numpy overhead) — ~6 ms that one bulk conversion does in ~1."""
+    return {
+        "seg_id": runs["seg_id"].tolist(),
+        "internal": runs["internal"].astype(bool).tolist(),
+        "start": runs["start"].tolist(),
+        "end": runs["end"].tolist(),
+        "length": runs["length"].tolist(),
+        "queue": runs["queue"].tolist(),
+        "begin_idx": runs["begin_idx"].tolist(),
+        "end_idx": runs["end_idx"].tolist(),
+        "way_off": runs["way_off"].tolist(),
+        "ways": runs["ways"].tolist(),
+    }
 
-    Converts the run columns to Python lists once per slice — per-element
-    ``int(arr[r])`` numpy-scalar extraction was ~2x the cost of the dict
-    builds themselves on the hot path."""
+
+def _format_runs(cols: dict, lo: int, hi: int, mode: str) -> dict:
+    """Run columns (as Python lists, see :func:`_runs_as_lists`)
+    [lo, hi) -> the reference-schema match dict (same keys/values as
+    matcher.assemble.assemble_segments; reference: README.md "Reporter
+    Output")."""
     n = hi - lo
     if n <= 0:
         return {"segments": [], "mode": mode}
-    seg_id = runs["seg_id"][lo:hi].tolist()
-    internal = runs["internal"][lo:hi].astype(bool).tolist()
-    start = runs["start"][lo:hi].tolist()
-    end = runs["end"][lo:hi].tolist()
-    length = runs["length"][lo:hi].tolist()
-    queue = runs["queue"][lo:hi].tolist()
-    begin_idx = runs["begin_idx"][lo:hi].tolist()
-    end_idx = runs["end_idx"][lo:hi].tolist()
-    w0 = int(runs["way_off"][lo])
-    way_off = (runs["way_off"][lo:hi + 1] - w0).tolist()
-    ways = runs["ways"][w0:int(runs["way_off"][hi])].tolist()
+    seg_id = cols["seg_id"]
+    internal = cols["internal"]
+    start = cols["start"]
+    end = cols["end"]
+    length = cols["length"]
+    queue = cols["queue"]
+    begin_idx = cols["begin_idx"]
+    end_idx = cols["end_idx"]
+    way_off = cols["way_off"]
+    ways = cols["ways"]
     segments = []
-    for r in range(n):
+    for r in range(lo, hi):
         entry = {
             "way_ids": ways[way_off[r]:way_off[r + 1]],
             "start_time": round(start[r], 3),
@@ -180,12 +199,6 @@ class SegmentMatcher:
             elif use_native:
                 raise RuntimeError("native host runtime requested but "
                                    "unavailable")
-        # shared prep pool, created lazily on the first batched call.
-        # Safe for both prep paths: the C++ runtime releases the GIL and
-        # stripe-locks its route cache; the numpy path's RouteCache dict
-        # ops are atomic under the GIL (races cost a redundant dijkstra,
-        # never corruption).
-        self._prep_pool: Optional[ThreadPoolExecutor] = None
         # two single-worker device lanes, each FIFO: the dispatch lane
         # runs decode dispatch + async d2h so the device queue stays fed,
         # the drain lane runs the d2h wait + assembly — so chunk N's
@@ -230,34 +243,17 @@ class SegmentMatcher:
         return prepare_trace(self.net, self.grid, points, params,
                              self.route_cache)
 
-    def _prepare_one(self, item):
-        """(index, trace, params) -> (index, PreparedTrace)."""
-        i, tr, params = item
-        return i, self.prepare(tr["trace"], params)
+    def match_many(self, traces) -> List[dict]:
+        """Match a batch of traces; returns match dicts in order.
 
-    def _prep_map(self, items):
-        """Prepare a chunk of (index, trace, params), in parallel when the
-        native runtime is present. Host prep (candidates + bounded
-        Dijkstra) is the end-to-end ceiling, not the decode — this is
-        where the reference's 16-process fan-out
-        (simple_reporter.py:265-297) is matched, with threads against the
-        GIL-releasing, lock-striped C++ runtime instead of processes.
-        The pure-Python numpy fallback holds the GIL, so threads would
-        only add contention there — it stays serial."""
-        workers = _prep_workers()
-        if self.runtime is None or workers <= 1 or len(items) <= 1:
-            return [self._prepare_one(it) for it in items]
-        if self._prep_pool is None:
-            self._prep_pool = ThreadPoolExecutor(
-                max_workers=workers, thread_name_prefix="prep")
-        return list(self._prep_pool.map(self._prepare_one, items))
-
-    def match_many(self, traces: Sequence[dict]) -> List[dict]:
-        """Match a batch of trace dicts; returns match dicts in order.
-
-        Each trace: {"uuid": ..., "trace": [{lat, lon, time, ...}, ...],
-        "match_options": {...}} — per-trace match_options may override
-        params (reference: generate_test_trace.py:45-52).
+        ``traces`` is either a columnar :class:`TraceBatch` (the zero-dict
+        hot path — the service, streaming worker, pipeline and bench all
+        ingest straight into one) or a sequence of request dicts
+        ({"uuid", "trace": [{lat, lon, time, ...}], "match_options"}),
+        converted to columns once at this edge. Per-trace match_options
+        may override params (reference: generate_test_trace.py:45-52); a
+        TraceBatch with one shared options dict resolves params once for
+        the whole batch.
 
         Chunked dispatch pipeline: the main thread runs host prep (one
         native call per chunk when the C++ runtime is present — zero
@@ -270,9 +266,17 @@ class SegmentMatcher:
         REPORTER_TPU_PIPELINE=0 runs both stages inline for a serialized
         per-stage breakdown.
         """
-        per_trace_params = [
-            self.params.with_options(tr.get("match_options", {}))
-            for tr in traces]
+        tb = as_trace_batch(traces)
+        ntr = len(tb)
+        opts = tb.options
+        if opts is None:
+            per_trace_params = [self.params] * ntr
+        elif isinstance(opts, dict):
+            per_trace_params = [self.params.with_options(opts)] * ntr
+        else:
+            per_trace_params = [
+                self.params.with_options(o) if o else self.params
+                for o in opts]
 
         # deferred: importing at module level would cycle through
         # ops -> pallas_viterbi -> matcher.hmm -> matcher/__init__
@@ -286,7 +290,7 @@ class SegmentMatcher:
         if pad:
             chunk = ((chunk + pad - 1) // pad) * pad
 
-        results: List[Optional[dict]] = [None] * len(traces)
+        results: List[Optional[dict]] = [None] * ntr
         futures = []
         if pipeline_enabled():
             def submit(batch, order, sigma, beta):
@@ -304,10 +308,10 @@ class SegmentMatcher:
 
         try:
             if self.runtime is not None:
-                self._dispatch_native(traces, per_trace_params, chunk, pad,
+                self._dispatch_native(tb, per_trace_params, chunk, pad,
                                       submit)
             else:
-                self._dispatch_fallback(traces, per_trace_params, chunk,
+                self._dispatch_fallback(tb, per_trace_params, chunk,
                                         pad, submit)
         except BaseException:
             # a prep-phase failure must quiesce the lanes before it
@@ -376,10 +380,11 @@ class SegmentMatcher:
                     interpolation_distance_m=gp.interpolation_distance,
                     backward_tolerance_m=gp.backward_tolerance_m,
                     turn_penalty_factor=gp.turn_penalty_factor)
-                ro = runs["run_off"]
+                ro = runs["run_off"].tolist()
+                cols = _runs_as_lists(runs)
                 for b, i in enumerate(order):
                     results[i] = _format_runs(
-                        runs, int(ro[b]), int(ro[b + 1]),
+                        cols, ro[b], ro[b + 1],
                         per_trace_params[i].mode)
         else:
             # order is elementwise-aligned with batch.traces (the
@@ -406,59 +411,79 @@ class SegmentMatcher:
         "max_route_time_factor", "min_time_bound_s", "turn_penalty_factor",
         "queue_speed_threshold_kph")
 
-    def _dispatch_native(self, traces, per_trace_params, chunk, pad,
-                         submit):
-        """Hot path: group by prep params, bucket by raw length, then ONE
-        rt_prepare_batch call per chunk on this thread, handing each
-        prepared batch to ``submit`` (the device lanes)."""
-        groups: dict[tuple, list] = {}
-        for i, (tr, params) in enumerate(zip(traces, per_trace_params)):
-            key = tuple(getattr(params, f) for f in self._PREP_KEY_FIELDS)
-            groups.setdefault(key, []).append((i, tr, params))
+    def _param_groups(self, per_trace_params):
+        """[(params, index array)] — one group per distinct prep-param
+        key, insertion-ordered. The steady state (one shared options
+        dict, so one params object for the whole batch) is an identity
+        scan, no per-trace key tuples."""
+        ntr = len(per_trace_params)
+        if ntr == 0:
+            return []
+        p0 = per_trace_params[0]
+        if all(p is p0 for p in per_trace_params):
+            return [(p0, np.arange(ntr, dtype=np.int64))]
+        keyed: dict[tuple, tuple] = {}
+        for i, p in enumerate(per_trace_params):
+            key = tuple(getattr(p, f) for f in self._PREP_KEY_FIELDS)
+            got = keyed.get(key)
+            if got is None:
+                keyed[key] = (p, [i])
+            else:
+                got[1].append(i)
+        return [(p, np.asarray(idxs, dtype=np.int64))
+                for p, idxs in keyed.values()]
 
+    def _dispatch_native(self, tb: TraceBatch, per_trace_params, chunk,
+                         pad, submit):
+        """Hot path: group by prep params, bucket by raw length
+        (vectorised), then ONE rt_prepare_batch call per chunk on this
+        thread — the chunk's flat coordinate columns pass straight from
+        the TraceBatch to the native call, zero per-point Python —
+        handing each prepared batch to ``submit`` (the device lanes)."""
         workers = max(1, _prep_workers())
-        for key, items in groups.items():
-            params = items[0][2]
+        buckets = np.asarray(LENGTH_BUCKETS, dtype=np.int64)
+        # bucket by RAW length (kept length is only known after the
+        # native prep; raw is an upper bound, so a jitter-heavy trace
+        # may decode in a larger bucket — same decoded path, the SKIP
+        # tail is inert)
+        Ts = buckets[np.minimum(
+            np.searchsorted(buckets, np.maximum(tb.lengths(), 1)),
+            len(buckets) - 1)]
+        for params, idxs in self._param_groups(per_trace_params):
             sigma = np.float32(params.effective_sigma)
             beta = np.float32(params.beta)
-            # bucket by RAW length (kept length is only known after the
-            # native prep; raw is an upper bound, so a jitter-heavy trace
-            # may decode in a larger bucket — same decoded path, the SKIP
-            # tail is inert)
-            by_T: dict[int, list] = {}
-            for i, tr, _p in items:
-                T = bucket_length(max(len(tr["trace"]), 1))
-                by_T.setdefault(T, []).append((i, tr))
-            for T, bucket in sorted(by_T.items()):
+            for T in np.unique(Ts[idxs]).tolist():
+                bucket = idxs[Ts[idxs] == T]
                 for lo in range(0, len(bucket), chunk):
                     part = bucket[lo:lo + chunk]
-                    order = [i for i, _tr in part]
+                    order = part.tolist()
                     rows = padded_batch_rows(len(part), pad)
                     with metrics.timer("matcher.prep"):
                         batch = prepare_batch(
-                            self.runtime, [tr["trace"] for _i, tr in part],
-                            params, T, pad_rows=rows, n_threads=workers)
+                            self.runtime, tb.gather(part),
+                            params, int(T), pad_rows=rows,
+                            n_threads=workers)
                     submit(batch, order, sigma, beta)
 
-    def _dispatch_fallback(self, traces, per_trace_params, chunk, pad,
-                           submit):
-        """numpy prep path (no native library): per-trace prepare_trace +
-        pack_batches — same contract, slower."""
-        groups: dict[tuple, list] = {}
-        for i, (tr, params) in enumerate(zip(traces, per_trace_params)):
-            key = (params.effective_sigma, params.beta)
-            groups.setdefault(key, []).append((i, tr, params))
-
-        for (sigma, beta), items in groups.items():
-            for lo in range(0, len(items), chunk):
+    def _dispatch_fallback(self, tb: TraceBatch, per_trace_params, chunk,
+                           pad, submit):
+        """numpy prep path (no native library): whole-chunk vectorised
+        candidate search + per-trace route tensors through the shared
+        cross-batch route cache, then pack_batches — same contract as the
+        native path, slower."""
+        for params, idxs in self._param_groups(per_trace_params):
+            sigma = np.float32(params.effective_sigma)
+            beta = np.float32(params.beta)
+            for lo in range(0, len(idxs), chunk):
+                part = idxs[lo:lo + chunk]
                 with metrics.timer("matcher.prep"):
-                    prepped = self._prep_map(items[lo:lo + chunk])
-                idx_of = {id(p): i for i, p in prepped}
-                group = [p for _i, p in prepped]
-                for batch in pack_batches(group, pad_batch_to=pad,
+                    prepped = prepare_traces_numpy(
+                        self.net, self.grid, tb.gather(part), params,
+                        self.route_cache)
+                idx_of = {id(p): i for p, i in zip(prepped, part.tolist())}
+                for batch in pack_batches(prepped, pad_batch_to=pad,
                                           pad_pow2=True):
                     # rows of a packed batch align with its traces list,
                     # so order[b] is the global index of batch.traces[b]
                     order = [idx_of[id(p)] for p in batch.traces]
-                    submit(batch, order, np.float32(sigma),
-                           np.float32(beta))
+                    submit(batch, order, sigma, beta)

@@ -19,8 +19,16 @@ class MatchParams:
     # floor on the time-admissibility cap max(floor, factor*dt), the time
     # analog of the 500 m floor on the distance bound: at 1 Hz sampling
     # factor*dt is ~2 s, which GPS projection noise alone overruns, so an
-    # unfloored bound prunes honest transitions instead of absurd detours
-    min_time_bound_s: float = 60.0
+    # unfloored bound prunes honest transitions instead of absurd detours.
+    # The floor is sized to NOISE-scale jumps, not the full distance
+    # bound: a projection hop of ~100 m at a slow-but-moving 25 km/h
+    # takes ~15 s, so 15 s keeps every honest noise-induced route while
+    # pruning teleports (e.g. 250 m of 30 km/h road "travelled" between
+    # 1 Hz probes). The previous 60 s floor — sized to the 500 m distance
+    # floor at 30 km/h — made the bound nearly inert at defaults: it only
+    # ever pruned sub-30 km/h crawls sustained for a full minute.
+    # Observable in tests/test_knobs.py::test_time_floor_prunes_teleport.
+    min_time_bound_s: float = 15.0
     breakage_distance: float = 2000.0  # meters; larger probe gaps split the HMM
     search_radius: float = 50.0        # meters candidate search radius
     turn_penalty_factor: float = 0.0

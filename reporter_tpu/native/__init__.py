@@ -30,7 +30,7 @@ _LIB_PATH = os.path.join(_DIR, "libreporter_host.so")
 # Must equal host_runtime.cpp's rt_abi_version(). The handshake in
 # _get_lib() turns a half-landed ABI change (library and binding updated
 # in different commits) into a loud numpy fallback instead of a segfault.
-ABI_VERSION = 9
+ABI_VERSION = 10
 _lib = None
 _build_lock = threading.Lock()
 _build_failed = False
@@ -252,7 +252,7 @@ class NativeRuntime:
                        backward_tolerance_m: float = 0.0,
                        dt=None,
                        max_route_time_factor: float = 0.0,
-                       min_time_bound_s: float = 60.0,
+                       min_time_bound_s: float = 15.0,
                        turn_penalty_factor: float = 0.0) -> np.ndarray:
         """(T-1, K, K) route distances; Meili's admissibility bounds.
 
@@ -294,7 +294,7 @@ class NativeRuntime:
                       min_bound_m: float = 500.0,
                       backward_tolerance_m: float = 0.0,
                       max_route_time_factor: float = 0.0,
-                      min_time_bound_s: float = 60.0,
+                      min_time_bound_s: float = 15.0,
                       turn_penalty_factor: float = 0.0,
                       n_threads: int = 0, n_rows: int | None = None):
         """Prepare B traces in ONE native call, straight into padded
@@ -327,14 +327,19 @@ class NativeRuntime:
         from ..graph.spatial import PAD_DIST, PAD_EDGE
         from ..graph.route import UNREACHABLE
         from ..matcher.hmm import SKIP
+        # np.empty, not np.full: the C++ call writes every row of its B
+        # traces (live prefixes AND pad sentinels, in the worker threads)
+        # — pre-filling 8-16 MB per chunk from Python was measured host
+        # time for bytes the callee immediately overwrites. Only filler
+        # rows beyond B (mesh/pow2 batch padding) are filled here.
         out = {
-            "edge_ids": np.full((rows, T, K), PAD_EDGE, np.int32),
-            "dist_m": np.full((rows, T, K), PAD_DIST, np.float32),
-            "offset_m": np.zeros((rows, T, K), np.float32),
-            "route_m": np.full((rows, T, K, K), UNREACHABLE, np.float32),
-            "gc_m": np.zeros((rows, T), np.float32),
-            "case": np.full((rows, T), SKIP, np.int32),
-            "kept_idx": np.full((rows, T), -1, np.int32),
+            "edge_ids": np.empty((rows, T, K), np.int32),
+            "dist_m": np.empty((rows, T, K), np.float32),
+            "offset_m": np.empty((rows, T, K), np.float32),
+            "route_m": np.empty((rows, T, K, K), np.float32),
+            "gc_m": np.empty((rows, T), np.float32),
+            "case": np.empty((rows, T), np.int32),
+            "kept_idx": np.empty((rows, T), np.int32),
             "num_kept": np.zeros(rows, np.int32),
             "dwell": np.zeros(rows, np.float32),
             # per RAW point: had any candidate edge (flat over pt_off) —
@@ -346,6 +351,14 @@ class NativeRuntime:
             # the tensors
             "max_finite": np.zeros(1, np.float32),
         }
+        if rows > B:
+            out["edge_ids"][B:] = PAD_EDGE
+            out["dist_m"][B:] = PAD_DIST
+            out["offset_m"][B:] = 0.0
+            out["route_m"][B:] = UNREACHABLE
+            out["gc_m"][B:] = 0.0
+            out["case"][B:] = SKIP
+            out["kept_idx"][B:] = -1
         lat0, lon0 = self.net.projection_anchor()
         self._lib.rt_prepare_batch(
             self._handle, B, pt_off, lat, lon, times,

@@ -47,6 +47,12 @@ struct Graph {
   std::vector<float> edge_len;
   std::vector<float> edge_speed;       // kph; for route travel time
   std::vector<float> head_x, head_y;   // unit heading per edge; turn costs
+  // SoA segment geometry for the candidate projection hot loop: one
+  // contiguous stream per operand instead of two node-table indirections
+  // per endpoint per edge per probe point. e_len2 keeps the DIVIDE
+  // (f = dot / len2) — a precomputed reciprocal would drift a ulp from
+  // the numpy path (graph/spatial.py) and flip distance ties.
+  std::vector<double> e_ax, e_ay, e_dx, e_dy, e_len2;
 
   // CSR out-adjacency
   std::vector<int64_t> csr_off;
@@ -148,15 +154,25 @@ struct Graph {
 
   void build(double cell_m) {
     cell = cell_m;
-    // unit headings (straight-segment geometry)
+    // unit headings (straight-segment geometry) + SoA projection columns
     head_x.resize(n_edges);
     head_y.resize(n_edges);
+    e_ax.resize(n_edges);
+    e_ay.resize(n_edges);
+    e_dx.resize(n_edges);
+    e_dy.resize(n_edges);
+    e_len2.resize(n_edges);
     for (int64_t e = 0; e < n_edges; ++e) {
       const double dx = node_x[edge_end[e]] - node_x[edge_start[e]];
       const double dy = node_y[edge_end[e]] - node_y[edge_start[e]];
       const double n = std::max(std::hypot(dx, dy), 1e-9);
       head_x[e] = static_cast<float>(dx / n);
       head_y[e] = static_cast<float>(dy / n);
+      e_ax[e] = node_x[edge_start[e]];
+      e_ay[e] = node_y[edge_start[e]];
+      e_dx[e] = dx;
+      e_dy[e] = dy;
+      e_len2[e] = std::max(dx * dx + dy * dy, 1e-9);
     }
     // CSR
     csr_off.assign(n_nodes + 1, 0);
@@ -280,13 +296,10 @@ void candidates_for_point(const Graph* g, double x, double y, int32_t k,
     }
   }
   for (int32_t e : s.nbr_edges) {
-    const double ax = g->node_x[g->edge_start[e]];
-    const double ay = g->node_y[g->edge_start[e]];
-    const double bx = g->node_x[g->edge_end[e]];
-    const double by = g->node_y[g->edge_end[e]];
-    const double dx = bx - ax, dy = by - ay;
-    const double len2 = std::max(dx * dx + dy * dy, 1e-9);
-    double f = ((x - ax) * dx + (y - ay) * dy) / len2;
+    const double ax = g->e_ax[e];
+    const double ay = g->e_ay[e];
+    const double dx = g->e_dx[e], dy = g->e_dy[e];
+    double f = ((x - ax) * dx + (y - ay) * dy) / g->e_len2[e];
     f = std::min(1.0, std::max(0.0, f));
     const double qx = ax + f * dx, qy = ay + f * dy;
     // cheap squared-distance prefilter (with ulp slack) so the exact
@@ -453,7 +466,7 @@ extern "C" {
 // numpy path loudly instead of calling through a stale signature. BUMP
 // THIS on ANY change to the signatures below, in the same commit as the
 // Python-side constant.
-int32_t rt_abi_version(void) { return 9; }
+int32_t rt_abi_version(void) { return 10; }
 
 void* rt_graph_create(int64_t n_nodes, int64_t n_edges,
                       const double* node_x, const double* node_y,
@@ -556,9 +569,11 @@ void rt_route_matrices(void* handle, int64_t T, int32_t K,
 // time/turn bounds via route_step above. dt derives from times over
 // kept points when time_factor > 0.
 //
-// Caller pre-fills outputs with pad sentinels (SKIP case, kPadEdge,
-// kPadDist, kUnreachable, kept=-1); this call writes only the live
-// prefix rows of each trace. out_dwell gets the trailing jitter dwell
+// This call writes EVERY row of its n_traces traces — live prefixes and
+// pad sentinels (SKIP case, kPadEdge, kPadDist, kUnreachable, kept=-1)
+// — so the caller may hand in uninitialised (np.empty) tensors; only
+// filler rows beyond n_traces (mesh/pow2 batch padding) remain the
+// caller's to fill. out_dwell gets the trailing jitter dwell
 // (batchpad.py:109-123 semantics). n_threads <= 0 picks
 // hardware_concurrency; traces fan out across threads (the route cache
 // is lock-striped; ctypes releases the GIL for the whole call).
@@ -624,7 +639,32 @@ void rt_prepare_batch(void* handle, int64_t n_traces, const int64_t* pt_off,
     int32_t* kept_b = out_kept + b * T;
     out_num_kept[b] = 0;
     out_dwell[b] = 0.0f;
-    if (n_raw <= 0) return;
+    // pad sentinels for rows beyond the live prefix — written HERE (in
+    // the worker threads, one pass, only the dead region) instead of a
+    // caller-side np.full over the whole 8-16 MB batch that the live
+    // rows immediately overwrite
+    auto fill_tail = [&](int32_t live_t, int32_t live_route) {
+      for (int32_t t = live_t; t < T; ++t) {
+        int32_t* er = edge_b + static_cast<int64_t>(t) * K;
+        float* dr = dist_b + static_cast<int64_t>(t) * K;
+        float* fr = off_b + static_cast<int64_t>(t) * K;
+        for (int32_t q = 0; q < K; ++q) {
+          er[q] = kPadEdge;
+          dr[q] = kPadDist;
+          fr[q] = 0.0f;
+        }
+        case_b[t] = 2;  // SKIP
+        kept_b[t] = -1;
+      }
+      std::fill_n(route_b + static_cast<int64_t>(live_route) * K * K,
+                  static_cast<int64_t>(T - live_route) * K * K,
+                  kUnreachable);
+      std::fill_n(gc_b + live_route, T - live_route, 0.0f);
+    };
+    if (n_raw <= 0) {
+      fill_tail(0, 0);
+      return;
+    }
 
     clk::time_point tp;
     if (timings) tp = clk::now();
@@ -669,7 +709,10 @@ void rt_prepare_batch(void* handle, int64_t n_traces, const int64_t* pt_off,
     const int32_t n =
         static_cast<int32_t>(std::min<size_t>(kept.size(), T));
     out_num_kept[b] = n;
-    if (n == 0) return;
+    if (n == 0) {
+      fill_tail(0, 0);
+      return;
+    }
 
     // trailing jitter dwell: every raw point after the last kept one has
     // candidates and sits within interpolation_distance of it — the
@@ -742,6 +785,7 @@ void rt_prepare_batch(void* handle, int64_t n_traces, const int64_t* pt_off,
           turn_penalty_factor, route_b + static_cast<int64_t>(t) * K * K);
       if (step_max > local_max) local_max = step_max;
     }
+    fill_tail(n, n - 1);
     bump_max(local_max);
     if (timings) ns_route += (clk::now() - tp).count();
   };

@@ -195,6 +195,45 @@ def _download_s3(url: str, key_regex: str) -> List[str]:
 MAX_WINDOW_POINTS = 1024
 
 
+def _window_spans(times, inactivity: int,
+                  max_window: int = MAX_WINDOW_POINTS,
+                  holdback_s: int = 15) -> Iterable[tuple]:
+    """Index spans ``(lo, hi)`` of matcher windows over a sorted times
+    array: split at gaps > ``inactivity`` seconds (reference:
+    simple_reporter.py:149-163), then chunk long windows with a
+    trailing-holdback overlap (see :func:`_windows_of`). Operating on
+    index spans keeps the columnar pipeline zero-copy: each window is a
+    slice of the uuid's coordinate arrays, never a list of point dicts.
+    """
+    import numpy as np
+
+    times = np.asarray(times, dtype=np.float64)
+    n = len(times)
+
+    def chunked(start: int, end: int) -> Iterable[tuple]:
+        while end - start > max_window:
+            yield (start, start + max_window)
+            end_t = times[start + max_window - 1]
+            j = max_window - 1
+            while j > 0 and end_t - times[start + j] <= holdback_s:
+                j -= 1
+            # progress floor: a pathological burst (>max_window points
+            # inside one holdback span) must not degrade to 1-point steps
+            # and ~N chunks; advancing at least half a window caps the
+            # re-presented overlap at 2x total work
+            j = max(max_window // 2, min(j, max_window - 1))
+            start += j
+        if end - start >= 2:
+            yield (start, end)
+
+    gap_at = np.flatnonzero(np.diff(times) > inactivity) + 1
+    lo = 0
+    for g in gap_at.tolist() + [n]:
+        if g - lo >= 2:
+            yield from chunked(lo, g)
+        lo = g
+
+
 def _windows_of(points: List[dict], inactivity: int,
                 max_window: int = MAX_WINDOW_POINTS,
                 holdback_s: int = 15) -> Iterable[List[dict]]:
@@ -208,33 +247,12 @@ def _windows_of(points: List[dict], inactivity: int,
     (reference: Batch.java:73-76, reporter_service.py:89-92): report()
     withholds segments inside the trailing holdback, and the next chunk
     re-presents those points, so pairs at the seam are reported exactly
-    once with match context preserved.
+    once with match context preserved. (Dict-list convenience wrapper
+    over :func:`_window_spans`, which the columnar stage uses directly.)
     """
-    def chunked(w: List[dict]) -> Iterable[List[dict]]:
-        while len(w) > max_window:
-            chunk = w[:max_window]
-            yield chunk
-            end_t = chunk[-1]["time"]
-            j = max_window - 1
-            while j > 0 and end_t - w[j]["time"] <= holdback_s:
-                j -= 1
-            # progress floor: a pathological burst (>max_window points
-            # inside one holdback span) must not degrade to 1-point steps
-            # and ~N chunks; advancing at least half a window caps the
-            # re-presented overlap at 2x total work
-            j = max(max_window // 2, min(j, max_window - 1))
-            w = w[j:]
-        if len(w) >= 2:
-            yield w
-
-    start = 0
-    for i in range(1, len(points)):
-        if points[i]["time"] - points[i - 1]["time"] > inactivity:
-            if i - start >= 2:
-                yield from chunked(points[start:i])
-            start = i
-    if len(points) - start >= 2:
-        yield from chunked(points[start:])
+    times = [p["time"] for p in points]
+    for lo, hi in _window_spans(times, inactivity, max_window, holdback_s):
+        yield points[lo:hi]
 
 
 def match_traces(trace_dir: str, matcher, mode: str,
@@ -246,6 +264,9 @@ def match_traces(trace_dir: str, matcher, mode: str,
 
     ``matcher`` is a SegmentMatcher (or anything with ``match_many``).
     """
+    import numpy as np
+
+    from ..core.tracebatch import TraceBatch
     from ..service.report import report as make_report
 
     dest_dir = tempfile.mkdtemp(prefix="matches_", dir=".")
@@ -258,32 +279,43 @@ def match_traces(trace_dir: str, matcher, mode: str,
             by_shard.setdefault(f.split(".")[0], []).append(
                 os.path.join(r, f))
     total_traces = 0
+    shared_opts = {"mode": mode}
     for shard, paths in sorted(by_shard.items()):
-        by_uuid: dict[str, list[dict]] = {}
+        # columnar parse: per-uuid coordinate LISTS (one append per row,
+        # never a point dict), then arrays + argsort per uuid
+        by_uuid: dict[str, tuple] = {}
         for path in paths:
             with open(path) as f:
                 for line in f:
                     try:
                         uuid, tm, lat, lon, acc = line.strip().split(",")
-                        by_uuid.setdefault(uuid, []).append({
-                            "lat": float(lat), "lon": float(lon),
-                            "time": int(tm), "accuracy": int(acc)})
+                        cols = by_uuid.get(uuid)
+                        if cols is None:
+                            cols = by_uuid[uuid] = ([], [], [], [])
+                        cols[0].append(int(tm))
+                        cols[1].append(float(lat))
+                        cols[2].append(float(lon))
+                        cols[3].append(int(acc))
                     except ValueError:
                         continue
 
-        # build every window request in this shard up front. The chunker's
-        # holdback must equal report()'s threshold: report withholds
-        # segments starting within threshold_sec of a chunk's end, and the
-        # next chunk re-presents exactly that span
-        requests = []
-        for uuid, points in by_uuid.items():
-            points.sort(key=lambda p: p["time"])
-            for window in _windows_of(points, inactivity,
+        # build every window request in this shard up front, as columnar
+        # parts (uuid, lat, lon, time, accuracy, options) over array
+        # slices. The chunker's holdback must equal report()'s threshold:
+        # report withholds segments starting within threshold_sec of a
+        # chunk's end, and the next chunk re-presents exactly that span
+        parts = []
+        for uuid, (tms, lats, lons, accs) in by_uuid.items():
+            tm = np.asarray(tms, dtype=np.float64)
+            order = np.argsort(tm, kind="stable")
+            tm = tm[order]
+            la = np.asarray(lats, dtype=np.float64)[order]
+            lo_ = np.asarray(lons, dtype=np.float64)[order]
+            ac = np.asarray(accs, dtype=np.float32)[order]
+            for a, b in _window_spans(tm, inactivity,
                                       holdback_s=threshold_sec):
-                requests.append({
-                    "uuid": uuid, "trace": window,
-                    "match_options": {"mode": mode},
-                })
+                parts.append((uuid, la[a:b], lo_[a:b], tm[a:b], ac[a:b],
+                              shared_opts))
 
         tiles: dict[str, list[str]] = {}
         # exactly-once across chunk seams: a uuid's windows are processed
@@ -291,38 +323,38 @@ def match_traces(trace_dir: str, matcher, mode: str,
         # a trace, so dropping reports at or below the uuid's
         # highest-emitted t0 removes seam duplicates (and nothing else)
         last_t0: dict[str, float] = {}
-        for lo in range(0, len(requests), device_batch):
-            chunk = requests[lo:lo + device_batch]
+        for lo in range(0, len(parts), device_batch):
+            tb = TraceBatch.concat(parts[lo:lo + device_batch])
             try:
-                matches = matcher.match_many(chunk)
+                matches = matcher.match_many(tb)
             except Exception as e:
                 logger.error("Batch match failed for %s: %s", shard, e)
                 continue
-            for trace, match in zip(chunk, matches):
+            for trace, match in zip(tb, matches):
+                uuid = trace["uuid"]
                 try:
                     rep = make_report(match, trace, threshold_sec,
                                       report_levels, transition_levels)
                 except Exception:
                     logger.error("Failed to report trace with uuid %s "
-                                 "from file %s", trace["uuid"], shard)
+                                 "from file %s", uuid, shard)
                     continue
-                floor = last_t0.get(trace["uuid"])
+                floor = last_t0.get(uuid)
                 reports = rep["datastore"]["reports"]
                 if floor is not None:
                     reports = [r for r in reports if r["t0"] > floor]
                     rep["datastore"]["reports"] = reports
                 if reports:
-                    last_t0[trace["uuid"]] = max(
-                        r["t0"] for r in reports)
+                    last_t0[uuid] = max(r["t0"] for r in reports)
                 _emit_rows(rep, trace, quantisation, source, mode, tiles)
         for tile_file, rows in tiles.items():
             path = os.path.join(dest_dir, tile_file)
             os.makedirs(os.path.dirname(path), exist_ok=True)
             with open(path, "a") as f:
                 f.writelines(rows)
-        total_traces += len(requests)
+        total_traces += len(parts)
         logger.info("Finished matching %d windows in %s",
-                    len(requests), shard)
+                    len(parts), shard)
     logger.info("Matched %d windows total", total_traces)
     return dest_dir
 

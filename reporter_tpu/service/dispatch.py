@@ -17,14 +17,21 @@ import threading
 import time
 from typing import Callable, List, Optional, Sequence
 
+from ..core.tracebatch import TraceBatch
 from ..utils import metrics
 
 
 class _Slot:
-    __slots__ = ("trace", "event", "result", "error")
+    __slots__ = ("trace", "columns", "event", "result", "error")
 
-    def __init__(self, trace: dict):
+    def __init__(self, trace, columns: Optional[tuple] = None):
         self.trace = trace
+        # (uuid, lat, lon, time, accuracy, options) column arrays, built
+        # by the submitting request thread (so columnarisation fans out
+        # across the handler pool); None for callers that submit plain
+        # dicts — a whole-batch of columnar slots reaches the matcher as
+        # ONE TraceBatch with zero per-point Python in the dispatch loop
+        self.columns = columns
         self.event = threading.Event()
         self.result: Optional[dict] = None
         self.error: Optional[Exception] = None
@@ -56,11 +63,14 @@ class BatchDispatcher:
         self._thread.start()
 
     # ---- request side ----------------------------------------------------
-    def submit(self, trace: dict, timeout: float = 60.0) -> dict:
-        """Block until the trace's match result is ready."""
+    def submit(self, trace: dict, timeout: float = 60.0,
+               columns: Optional[tuple] = None) -> dict:
+        """Block until the trace's match result is ready. ``columns`` is
+        the trace's pre-built (uuid, lat, lon, time, accuracy, options)
+        column tuple when the caller already columnarised the wire."""
         if self._closed:
             raise RuntimeError("dispatcher is closed")
-        slot = _Slot(trace)
+        slot = _Slot(trace, columns)
         self._queue.put(slot)
         if not slot.event.wait(timeout):
             raise TimeoutError("match result not ready in time")
@@ -86,7 +96,17 @@ class BatchDispatcher:
         """
         if self._closed:
             raise RuntimeError("dispatcher is closed")
-        slots = [_Slot(tr) for tr in traces]
+        if isinstance(traces, TraceBatch):
+            acc = traces.accuracy
+            off = traces.offsets
+            slots = [
+                _Slot(traces[i], (traces.uuid(i), *traces.trace_columns(i),
+                                  acc[off[i]:off[i + 1]]
+                                  if acc is not None else None,
+                                  traces.option(i)))
+                for i in range(len(traces))]
+        else:
+            slots = [_Slot(tr) for tr in traces]
         for slot in slots:  # enqueue ALL before waiting on any
             self._queue.put(slot)
         n_batches = max(1, -(-len(slots) // self.max_batch))
@@ -132,8 +152,15 @@ class BatchDispatcher:
             metrics.count("dispatch.batches")
             metrics.count("dispatch.traces", len(slots))
             try:
+                # a batch of columnar slots concatenates into ONE
+                # TraceBatch (flat arrays, no per-point Python); plain
+                # dict submissions fall back to the request-dict path
+                if all(s.columns is not None for s in slots):
+                    batch = TraceBatch.concat([s.columns for s in slots])
+                else:
+                    batch = [s.trace for s in slots]
                 with metrics.timer("dispatch.match_many"):
-                    results = self._match_many([s.trace for s in slots])
+                    results = self._match_many(batch)
                 for slot, res in zip(slots, results):
                     slot.result = res
             except Exception as e:  # propagate to every waiter in the batch

@@ -29,6 +29,7 @@ import threading
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from ..core.tracebatch import points_to_columns
 from ..matcher import Configure, SegmentMatcher
 from ..utils import metrics
 from .dispatch import BatchDispatcher
@@ -76,20 +77,26 @@ class ReporterService:
         except Exception:
             return 400, '{"error":"match_options must include transition_levels array"}'
         try:
-            match = self.dispatcher.submit(trace)
+            # columnarise the wire ONCE, in this request thread — the
+            # dispatch loop and matcher never touch point dicts again
+            lat, lon, tm, acc = points_to_columns(trace["trace"])
+            match = self.dispatcher.submit(
+                trace, columns=(trace.get("uuid"), lat, lon, tm, acc,
+                                trace.get("match_options")))
             data = report(match, trace, self.threshold_sec,
                           report_levels, transition_levels)
             return 200, json.dumps(data, separators=(",", ":"))
         except Exception as e:
             return 500, json.dumps({"error": str(e)})
 
-    def report_many(self, traces: list) -> list:
-        """Match + report a whole list in ONE dispatcher round trip (one
-        device batch up to MATCH_BATCH_MAX); returns parsed report dicts,
-        None for a trace that failed — a one-batch failure costs only
-        that batch's traces, and the cause is logged. The streaming
-        worker's in-process eviction path — no per-trace HTTP, no
-        per-trace JSON."""
+    def report_many(self, traces) -> list:
+        """Match + report a whole list — or a columnar
+        :class:`TraceBatch` — in ONE dispatcher round trip (one device
+        batch up to MATCH_BATCH_MAX); returns parsed report dicts, None
+        for a trace that failed — a one-batch failure costs only that
+        batch's traces, and the cause is logged. The streaming worker's
+        in-process flush path — no per-trace HTTP, no per-trace JSON, no
+        point dicts."""
         import logging
         log = logging.getLogger("reporter_tpu.service")
         matches = self.dispatcher.submit_many(traces,

@@ -26,8 +26,11 @@ import json
 import logging
 from typing import Callable, Dict, List, Optional, Tuple
 
+import numpy as np
+
 from ..core.geo import equirectangular_m
 from ..core.osmlr import INVALID_SEGMENT_ID
+from ..core.tracebatch import TraceBatch, TraceView
 from ..core.types import Point, Segment
 
 logger = logging.getLogger("reporter_tpu.streaming")
@@ -65,6 +68,22 @@ class Batch:
             },
             "trace": [p.to_json_obj() for p in self.points],
         }
+
+    def request_columns(self, uuid: str, options: dict) -> tuple:
+        """Columnar request part (uuid, lat, lon, time, accuracy,
+        options) straight from the Point objects — the zero-dict batched
+        flush path (TraceBatch.concat consumes these)."""
+        pts = self.points
+        n = len(pts)
+        # Point stores f32-rounded lat/lon on the binary wire; the json
+        # body rounds to 6 decimals identically, so columns == dict path
+        lat = np.fromiter((round(float(p.lat), 6) for p in pts),
+                          np.float64, n)
+        lon = np.fromiter((round(float(p.lon), 6) for p in pts),
+                          np.float64, n)
+        tm = np.fromiter((p.time for p in pts), np.float64, n)
+        acc = np.fromiter((p.accuracy for p in pts), np.float32, n)
+        return (uuid, lat, lon, tm, acc, options)
 
     def should_report(self, min_dist: float, min_size: int,
                       min_elapsed: float) -> bool:
@@ -175,10 +194,11 @@ class PointBatcher:
                  transition_on: str = "0,1",
                  session_gap_ms: int = SESSION_GAP_MS,
                  submit_many: Optional[Callable[
-                     [List[dict]], List[Optional[dict]]]] = None):
+                     [List[dict]], List[Optional[dict]]]] = None,
+                 report_flush: int = 64):
         self.submit = submit
-        # batched submit for the eviction path (one device batch for a
-        # whole punctuate flush); falls back to per-uuid submit
+        # batched submit for flush paths (one device batch for a whole
+        # punctuate/pending flush); falls back to per-uuid submit
         self.submit_many = submit_many or (
             lambda bodies: [self._submit_safe(b) for b in bodies])
         self.forward = forward
@@ -187,8 +207,24 @@ class PointBatcher:
         self.transition_on = transition_on
         self.session_gap_ms = session_gap_ms
         self.store: Dict[str, Batch] = {}
+        # ONE shared match_options dict for every request this batcher
+        # emits — lets the matcher resolve params once per device batch
+        self.options = {
+            "mode": mode,
+            "report_levels": [int(x) for x in report_on.split(",")],
+            "transition_levels": [int(x) for x in transition_on.split(",")],
+        }
+        # uuids whose batches crossed the report thresholds, awaiting the
+        # next batched flush (ordered set). The reference fires one
+        # matcher call per crossing (Batch.java:66-68); deferring them a
+        # moment batches many sessions into one padded device decode —
+        # same results, the window just extends by a few probes.
+        self.pending: Dict[str, None] = {}
+        self.report_flush = max(1, int(report_flush))
 
-    def _submit_safe(self, body: dict) -> Optional[dict]:
+    def _submit_safe(self, body) -> Optional[dict]:
+        if isinstance(body, TraceView):
+            body = body.to_request()  # per-trace HTTP path wants JSON
         try:
             return self.submit(body)
         except Exception as e:
@@ -209,35 +245,66 @@ class PointBatcher:
             batch = Batch(point)
         else:
             batch.update(point)
-            response = batch.report(
-                uuid, self.submit, self.mode, self.report_on,
-                self.transition_on, REPORT_DIST, REPORT_COUNT, REPORT_TIME)
-            self._forward_all(response)
+            if batch.should_report(REPORT_DIST, REPORT_COUNT, REPORT_TIME):
+                # defer to the next batched flush instead of matching
+                # this one session at batch=1 (the reference's only mode)
+                self.pending[uuid] = None
         if batch.points:
             batch.last_update = stream_time_ms
             self.store[uuid] = batch
+        if len(self.pending) >= self.report_flush:
+            self.flush_pending()
+
+    def _flush_due(self, due) -> None:
+        """ONE batched submit for (uuid, batch) pairs -> forward the
+        resulting segment pairs; bodies go columnar (TraceBatch), so the
+        in-process service path never builds a point dict."""
+        if not due:
+            return
+        tb = TraceBatch.concat([
+            batch.request_columns(uuid, self.options)
+            for uuid, batch in due])
+        responses = self.submit_many(tb)
+        for (uuid, batch), response in zip(due, responses):
+            self._forward_all(batch.apply_response(uuid, response))
+
+    def flush_pending(self) -> None:
+        """Flush every session that crossed the report thresholds since
+        the last flush through ONE device batch. Sessions evicted or
+        trimmed in the meantime re-qualify on their next point."""
+        if not self.pending:
+            return
+        due = []
+        for uuid in self.pending:
+            batch = self.store.get(uuid)
+            if batch is not None and batch.should_report(
+                    REPORT_DIST, REPORT_COUNT, REPORT_TIME):
+                due.append((uuid, batch))
+        self.pending.clear()
+        self._flush_due(due)
 
     def punctuate(self, stream_time_ms: int) -> None:
         """Evict batches idle past the session gap, reporting what we can
         with relaxed thresholds (reference: BatchingProcessor.java:87-106).
 
-        All evicted uuids flush through ONE ``submit_many`` call, so a
-        punctuate cycle evicting N sessions decodes as one padded device
-        batch of N — not N batches of 1 (the round-1..3 weakness; the
-        reference can only do one C++ call per trace, Batch.java:66-68).
+        Evicted uuids AND pending mid-stream reports flush through ONE
+        ``submit_many`` call, so a punctuate cycle over N sessions
+        decodes as one padded device batch of N — not N batches of 1
+        (the round-1..3 weakness; the reference can only do one C++ call
+        per trace, Batch.java:66-68).
         """
         due = []
         for uuid in list(self.store):
             batch = self.store[uuid]
             if stream_time_ms - batch.last_update > self.session_gap_ms:
                 del self.store[uuid]
+                self.pending.pop(uuid, None)
                 if batch.should_report(0, 2, 0):
                     due.append((uuid, batch))
-        if not due:
-            return
-        bodies = [batch.request_body(uuid, self.mode, self.report_on,
-                                     self.transition_on)
-                  for uuid, batch in due]
-        responses = self.submit_many(bodies)
-        for (uuid, batch), response in zip(due, responses):
-            self._forward_all(batch.apply_response(uuid, response))
+        for uuid in self.pending:  # still live, thresholds crossed
+            batch = self.store.get(uuid)
+            if batch is not None and batch.should_report(
+                    REPORT_DIST, REPORT_COUNT, REPORT_TIME):
+                due.append((uuid, batch))
+        self.pending.clear()
+        self._flush_due(due)

@@ -77,7 +77,8 @@ class StreamWorker:
                  clock=time.time,
                  state=None,
                  uuid_filter: Optional[Callable[[str], bool]] = None,
-                 submit_many=None):
+                 submit_many=None,
+                 report_flush_interval_s: float = 1.0):
         self.formatter = formatter
         # multi-host: predicate deciding which uuids this worker owns
         # (parallel.multihost — the Kafka keyed-partition contract when the
@@ -96,6 +97,12 @@ class StreamWorker:
         self.parse_failures = 0
         self._last_flush = clock()
         self._last_evict = clock()
+        # wall-clock bound on how long a threshold-crossed session may sit
+        # in the batcher's pending set before a batched flush: keeps live
+        # report latency near the reference's immediate-fire behavior
+        # while a fast replay still accumulates whole device batches
+        self.report_flush_interval_s = report_flush_interval_s
+        self._last_report_flush = clock()
         # durable state (StateStore): restore open batches + tile slices
         # from the last snapshot — the reference instead loses in-memory
         # state on crash (BatchingProcessor.java:20-22, SURVEY.md §5)
@@ -125,6 +132,11 @@ class StreamWorker:
     def maybe_punctuate(self, force: bool = False) -> None:
         now = self.clock()
         flushed = False
+        if self.batcher.pending and (
+                force or now - self._last_report_flush
+                >= self.report_flush_interval_s):
+            self.batcher.flush_pending()
+            self._last_report_flush = now
         if force or (now - self._last_evict) * 1000 >= 2 * self.session_gap_ms:
             self.batcher.punctuate(int(now * 1000))
             self._last_evict = now

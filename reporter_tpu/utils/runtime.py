@@ -40,6 +40,13 @@ ENV_VIRTUAL_DEVICES = "REPORTER_TPU_VIRTUAL_DEVICES"
 ENV_PROBE_TIMEOUT = "REPORTER_TPU_PROBE_TIMEOUT_S"  # default 90
 ENV_PROBE_TRIES = "REPORTER_TPU_PROBE_TRIES"        # default 2
 ENV_COMPILE_CACHE = "REPORTER_TPU_COMPILE_CACHE"    # dir | "0" to disable
+# probe-verdict cache file shared by a process tree: the first
+# accelerator probe writes its verdict here and every later probe — in
+# this process or any child inheriting the env — reads it back instead
+# of burning another timeout. BENCH_r05 measured 4 sequential 90 s probe
+# timeouts (~6 min) in one bench run before the CPU fallback; with the
+# cache the tree pays for exactly one.
+ENV_PROBE_CACHE = "REPORTER_TPU_PROBE_CACHE"
 _DEVICE_COUNT_FLAG = "xla_force_host_platform_device_count"
 
 _decided: str | None = None  # this process's platform decision, once made
@@ -165,6 +172,33 @@ def force_virtual_cpu(n_devices: int | None = None) -> None:
     _decided = "cpu"
 
 
+def _probe_cache_read() -> dict | None:
+    path = os.environ.get(ENV_PROBE_CACHE)
+    if not path:
+        return None
+    try:
+        import json
+        with open(path) as f:
+            data = json.load(f)
+        if isinstance(data, dict) and "available" in data:
+            return data
+    except (OSError, ValueError):
+        pass
+    return None
+
+
+def _probe_cache_write(available: bool, reason: str | None) -> None:
+    path = os.environ.get(ENV_PROBE_CACHE)
+    if not path:
+        return
+    try:
+        import json
+        with open(path, "w") as f:
+            json.dump({"available": bool(available), "reason": reason}, f)
+    except OSError:  # pragma: no cover - best-effort cache
+        pass
+
+
 def accelerator_available(timeout_s: float | None = None,
                           tries: int | None = None) -> bool:
     """Probe whether the registered accelerator backend can initialise,
@@ -180,11 +214,24 @@ def accelerator_available(timeout_s: float | None = None,
     working plugin) is NOT evidence of an accelerator: the parent then
     takes the forced-CPU path, whose factory-popping guarantees an
     unconstrained init can't still block on a half-working plugin.
+
+    When ``REPORTER_TPU_PROBE_CACHE`` names a file, the first verdict is
+    written there and later calls — including child processes inheriting
+    the env — return it without re-probing (one timeout per process
+    tree, not one per probe site).
     """
     if timeout_s is None:
         timeout_s = _env_float(ENV_PROBE_TIMEOUT, 90.0)
     if tries is None:
         tries = _env_int(ENV_PROBE_TRIES, 2)
+    cached = _probe_cache_read()
+    if cached is not None:
+        probe_info.update({
+            "timeout_s": timeout_s, "tries": tries, "attempts": 0,
+            "cached": True,
+            "reason": f"cached: {cached.get('reason')}"})
+        log.info("accelerator probe verdict from cache: %s", cached)
+        return bool(cached["available"])
     probe_info.update({"timeout_s": timeout_s, "tries": tries,
                        "attempts": 0, "reason": None})
     code = ("import jax; d = jax.devices(); "
@@ -206,10 +253,12 @@ def accelerator_available(timeout_s: float | None = None,
         if proc.returncode == 0 and platform and platform != "cpu":
             log.info("accelerator probe ok: platform=%s", platform)
             probe_info["reason"] = f"probe ok: {platform}"
+            _probe_cache_write(True, probe_info["reason"])
             return True
         if proc.returncode == 0:
             log.info("probe came up on %r — no accelerator", platform)
             probe_info["reason"] = "probe came up on cpu — no accelerator"
+            _probe_cache_write(False, probe_info["reason"])
             return False
         log.warning("accelerator probe %d/%d failed rc=%d: %s",
                     attempt, tries, proc.returncode,
@@ -217,6 +266,7 @@ def accelerator_available(timeout_s: float | None = None,
         probe_info["reason"] = (
             f"probe failed rc={proc.returncode}: "
             + proc.stderr.strip()[-120:])
+    _probe_cache_write(False, probe_info["reason"])
     return False
 
 

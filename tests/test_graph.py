@@ -64,6 +64,30 @@ class TestSpatial:
         cands = grid.candidates(np.array([lat0]), np.array([120.98]), k=4)
         assert (cands.edge_ids[0] == PAD_EDGE).all()
 
+    def test_whole_batch_query_equals_per_point(self, city):
+        """The vectorised grid query over ALL points of many traces at
+        once (flat columns, the batched prep path) returns exactly the
+        per-trace results — including top-k distance-tie ordering and
+        points with no candidates."""
+        grid = SpatialGrid(city, cell_m=75.0)
+        rng = np.random.default_rng(12)
+        lat0, lon0 = float(city.node_lat.min()), float(city.node_lon.min())
+        # scatter points over the city plus a few far outside it
+        lat = lat0 + rng.uniform(-0.002, 0.02, 400)
+        lon = lon0 + rng.uniform(-0.002, 0.02, 400)
+        lat[::50] += 0.5  # candidate-less rows
+        whole = grid.candidates(lat, lon, k=5)
+        # split into uneven "traces" and query each separately
+        cuts = [0, 7, 64, 65, 200, 400]
+        for a, b in zip(cuts[:-1], cuts[1:]):
+            part = grid.candidates(lat[a:b], lon[a:b], k=5)
+            np.testing.assert_array_equal(whole.edge_ids[a:b],
+                                          part.edge_ids)
+            np.testing.assert_array_equal(whole.dist_m[a:b], part.dist_m)
+            np.testing.assert_array_equal(whole.offset_m[a:b],
+                                          part.offset_m)
+        assert (whole.edge_ids[::50] == PAD_EDGE).all()
+
 
 class TestRoute:
     def test_same_edge_forward(self, city):
@@ -107,10 +131,19 @@ class TestRoute:
 
     def test_cache_hits(self, city):
         cache = RouteCache(city)
-        route_distance(city, 0, 0.0, 5, 10.0, 5000.0, cache)
+        d0 = route_distance(city, 0, 0.0, 5, 10.0, 5000.0, cache)
         before = cache.misses
-        route_distance(city, 0, 0.0, 5, 20.0, 5000.0, cache)
-        assert cache.misses == before and cache.hits >= 1
+        # same edge pair, different offset: served from the PAIR level
+        # (no new Dijkstra, no node-dict probe), identical arithmetic
+        d1 = route_distance(city, 0, 0.0, 5, 20.0, 5000.0, cache)
+        assert cache.misses == before and cache.pair_hits >= 1
+        assert d1 == pytest.approx(d0 + 10.0)
+        # a different source edge still reuses the node-level entry when
+        # its Dijkstra was already run
+        cache2 = RouteCache(city)
+        route_distance(city, 0, 0.0, 5, 10.0, 5000.0, cache2)
+        cache2.distances_from(int(city.edge_end[0]), 1000.0)
+        assert cache2.hits >= 1
 
 
 class TestSynthTrace:

@@ -9,13 +9,15 @@ output. These tests pin observable behavior:
   0 -> 500 (the sharp-turn interpretation wins on emission alone, loses
   once the heading change is priced);
 - a slow-road transition PRUNED by the time bound when the
-  min_time_bound_s floor is lowered, and kept at the 60 s default floor
-  (the floor exists because at 1 Hz sampling factor*dt is ~2 s, which
-  GPS noise alone overruns — so at defaults the bound only prunes
-  routes that would take over a minute, i.e. sustained sub-30 km/h
-  crawls within the ~500 m distance bound or large sampling gaps);
+  min_time_bound_s floor is lowered, and kept for noise-scale routes at
+  the default floor (the floor exists because at 1 Hz sampling
+  factor*dt is ~2 s, which GPS noise alone overruns — the 15 s default
+  is sized to noise-scale projection hops, so the bound prunes
+  teleports the 60 s floor of rounds 3-5 let through; see
+  test_time_floor_prunes_teleport);
 - native-vs-numpy parity of full match output at those non-default
-  settings.
+  settings, including when the knobs arrive via per-request
+  match_options overrides (which split native prep groups).
 """
 import numpy as np
 import pytest
@@ -166,9 +168,9 @@ def test_time_bound_prunes_impossible_transition(slow_road, use_native):
     k1 = int(np.argmin(p.dist_m[1]))
     assert p.route_m[1, k1, k2] >= UNREACHABLE / 2
 
-    # default 60 s floor: cap = 60 s < 68 s travel -> still pruned for
-    # THIS crawl, proving the bound is live at defaults for sub-30 km/h
-    # routes; a faster road (50 km/h, ~14 s travel) must pass
+    # default floor: cap = max(15, 2*1s) = 15 s < 68 s travel -> still
+    # pruned for this crawl; a noise-scale route on a faster road
+    # (50 km/h, ~14 s travel) must pass (test_time_bound_inert_on_fast_road)
     dflt = SegmentMatcher(net=slow_road, use_native=use_native,
                           params=MatchParams())
     pd = dflt.prepare(pts)
@@ -191,12 +193,82 @@ def test_time_bound_inert_on_fast_road(use_native):
                             speeds=np.array([50.0, 50.0], dtype=np.float32))
     pts = _teleport_trace()
     m = SegmentMatcher(net=fast, use_native=use_native,
-                       params=MatchParams())  # defaults: factor 2, floor 60
+                       params=MatchParams())  # defaults: factor 2, floor 15
     p = m.prepare(pts)
     k2 = int(np.argmin(p.dist_m[2]))
     k1 = int(np.argmin(p.dist_m[1]))
-    # ~190 m at 50 km/h is ~14 s < the 60 s floor -> admissible
+    # ~186 m at 50 km/h is ~13.4 s < the 15 s floor -> admissible: the
+    # floor keeps noise-scale routes alive at moderate speeds
     assert p.route_m[1, k1, k2] < UNREACHABLE / 2
+
+
+@pytest.mark.parametrize("use_native", [True, False])
+def test_time_floor_prunes_teleport(use_native):
+    """The 15 s default floor makes the time bound LIVE at defaults: a
+    ~250 m stretch of 30 km/h road 'travelled' between 1 Hz probes takes
+    ~30 s > 15 s -> pruned, while the 60 s floor of rounds 3-5 (the time
+    analog of the 500 m distance floor, sized to the wrong scale) let
+    exactly this teleport through. The distance bound alone cannot catch
+    it (max(500, 5*gc) admits the ~250 m route)."""
+    if use_native and not native.available():
+        pytest.skip("native toolchain unavailable")
+    road = _net_from_meters(
+        [(0.0, 0.0), (300.0, 0.0), (600.0, 0.0)], [(0, 1), (1, 2)],
+        speeds=np.array([30.0, 30.0], dtype=np.float32))
+    pts = _pts_from_meters([(2.0, 1.0, 0.0), (14.0, -1.0, 1.0),
+                            (260.0, 1.0, 2.0)])
+    dflt = SegmentMatcher(net=road, use_native=use_native,
+                          params=MatchParams())
+    p = dflt.prepare(pts)
+    k1 = int(np.argmin(p.dist_m[1]))
+    k2 = int(np.argmin(p.dist_m[2]))
+    assert p.route_m[1, k1, k2] >= UNREACHABLE / 2, \
+        "teleport must be pruned at the default floor"
+    # the old 60 s floor admits it — pinning exactly what the default
+    # floor change buys
+    old = SegmentMatcher(net=road, use_native=use_native,
+                         params=MatchParams(min_time_bound_s=60.0))
+    po = old.prepare(pts)
+    assert po.route_m[1, k1, k2] < UNREACHABLE / 2
+
+
+@pytest.mark.parametrize("use_native", [True, False])
+def test_knobs_via_match_options_override(use_native):
+    """Per-request match_options carrying non-default knob values must
+    behave exactly like matcher-level params — the prep-param grouping
+    (matcher._PREP_KEY_FIELDS) splits them into their own native prep
+    call, and both paths agree."""
+    if use_native and not native.available():
+        pytest.skip("native toolchain unavailable")
+    road = _net_from_meters(
+        [(0.0, 0.0), (300.0, 0.0), (600.0, 0.0)], [(0, 1), (1, 2)],
+        speeds=np.array([30.0, 30.0], dtype=np.float32))
+    pts = _pts_from_meters([(2.0, 1.0, 0.0), (14.0, -1.0, 1.0),
+                            (260.0, 1.0, 2.0)])
+    base = {"mode": "auto", "report_levels": [0, 1, 2],
+            "transition_levels": [0, 1, 2]}
+    m = SegmentMatcher(net=road, use_native=use_native,
+                       params=MatchParams())
+    # one request at defaults (teleport pruned -> split match), one with
+    # the bound disabled via match_options (teleport admitted -> joined)
+    reqs = [
+        {"uuid": "dflt", "trace": pts, "match_options": dict(base)},
+        {"uuid": "loose", "trace": pts,
+         "match_options": dict(base, max_route_time_factor=0.0)},
+    ]
+    out = m.match_many(reqs)
+    ways_dflt = [w for s in out[0]["segments"] for w in s["way_ids"]]
+    ways_loose = [w for s in out[1]["segments"] for w in s["way_ids"]]
+    # with the bound off the decode routes through; at defaults the
+    # pruned transition breaks the chain (fewer/shorter joined spans)
+    assert ways_loose.count(0) >= 1
+    assert out[0] != out[1]
+    # parity with per-matcher params for the SAME knob values
+    loose_params = SegmentMatcher(
+        net=road, use_native=use_native,
+        params=MatchParams(max_route_time_factor=0.0))
+    want = loose_params.match_many([reqs[1]])[0]
+    assert out[1] == want
 
 
 def test_time_bound_native_numpy_parity(slow_road):

@@ -183,3 +183,80 @@ def test_eviction_batch_reaches_matcher_as_one_call(tmp_path):
     assert not b.store
     assert forwarded, "batched eviction forwarded no segment pairs"
     service.dispatcher.close()
+
+
+def _feed_big_session(batcher, uuid, t0, n=14, lat0=14.6):
+    """n points spanning > 500 m / > 60 s / > 10 pts: crosses the
+    mid-stream report thresholds while being fed."""
+    for i in range(n):
+        p = Point(lat=lat0 + i * 0.0006, lon=0.0, accuracy=10,
+                  time=t0 + i * 7)
+        batcher.process(uuid, p, stream_time_ms=(t0 + i * 7) * 1000)
+
+
+def test_midstream_reports_flush_as_one_batch():
+    """Sessions that cross the report thresholds mid-stream accumulate
+    in ``pending`` and flush through ONE submit_many call — the
+    reference fires one matcher call per crossing (Batch.java:66-68)."""
+    calls = []
+    single_calls = []
+    b = PointBatcher(lambda body: single_calls.append(body) or None,
+                     lambda k, s: None,
+                     submit_many=lambda bodies:
+                     calls.append([t["uuid"] for t in bodies])
+                     or [None] * len(bodies))
+    for j in range(4):
+        _feed_big_session(b, f"veh-{j}", t0=1000)
+    assert not single_calls, "mid-stream reports must not fire at batch=1"
+    assert len(b.pending) == 4
+    b.flush_pending()
+    assert [sorted(c) for c in calls] == [[f"veh-{j}" for j in range(4)]]
+    assert not b.pending
+    # a None response (failed round trip) drops the batch, reference
+    # semantics — the sessions are gone from the store
+    assert all(not batch.points for batch in b.store.values())
+
+
+def test_pending_flush_trims_consumed_prefix():
+    """A successful batched mid-stream response trims each session at
+    shape_used, exactly like the old inline per-trace path."""
+    seen = []
+
+    def submit_many(bodies):
+        seen.extend(bodies)
+        return [{"shape_used": 5} for _ in bodies]
+
+    b = PointBatcher(lambda body: None, lambda k, s: None,
+                     submit_many=submit_many)
+    _feed_big_session(b, "veh-x", t0=1000)
+    n_before = len(b.store["veh-x"].points)
+    b.flush_pending()
+    assert len(seen) == 1
+    assert len(b.store["veh-x"].points) == n_before - 5
+
+
+def test_pending_autoflush_at_report_flush_size():
+    calls = []
+    b = PointBatcher(lambda body: None, lambda k, s: None,
+                     submit_many=lambda bodies:
+                     calls.append(len(bodies)) or [None] * len(bodies),
+                     report_flush=3)
+    for j in range(3):
+        _feed_big_session(b, f"veh-{j}", t0=1000)
+    # third crossing hit report_flush=3 -> flushed without punctuate
+    assert calls and calls[0] == 3
+    assert not b.pending
+
+
+def test_punctuate_merges_pending_and_evictions_into_one_batch():
+    calls = []
+    b = PointBatcher(lambda body: None, lambda k, s: None,
+                     submit_many=lambda bodies:
+                     calls.append(sorted(t["uuid"] for t in bodies))
+                     or [None] * len(bodies))
+    _feed_big_session(b, "live", t0=10_000_000)   # pending, recent
+    _feed_session(b, "idle", t0=1000)             # below thresholds, stale
+    # stream time just past "live"'s last update: "idle" is evicted
+    # (stale), "live" is still open but pending — ONE batch carries both
+    b.punctuate(stream_time_ms=10_000_000 * 1000 + 14 * 7000 + 1)
+    assert calls == [["idle", "live"]]

@@ -1,0 +1,126 @@
+"""Cross-batch route pair cache: correctness under eviction, cache-on ==
+cache-off results, and metrics counters.
+
+The pair LRU (graph/route.py RouteCache) keys the node-to-node route
+kernel on (edge_from, edge_to) and reapplies offset
+arithmetic, turn penalties and the time-admissibility check per query —
+so a hit must be bit-identical to a recompute, at ANY capacity (eviction
+only costs recomputes, never correctness).
+"""
+import numpy as np
+import pytest
+
+from reporter_tpu.core.geo import equirectangular_m
+from reporter_tpu.core.tracebatch import TraceBatch
+from reporter_tpu.graph.route import RouteCache, candidate_route_matrices
+from reporter_tpu.graph.spatial import SpatialGrid
+from reporter_tpu.matcher import MatchParams, SegmentMatcher
+from reporter_tpu.synth import build_grid_city, generate_trace
+from reporter_tpu.utils import metrics
+
+
+@pytest.fixture(scope="module")
+def city():
+    return build_grid_city(rows=8, cols=8, spacing_m=200.0, seed=9)
+
+
+def _trace_tensors(city, grid, tr, K=6):
+    lat = np.array([p["lat"] for p in tr.points])
+    lon = np.array([p["lon"] for p in tr.points])
+    tm = np.array([p["time"] for p in tr.points], dtype=float)
+    cands = grid.candidates(lat, lon, K, 50.0)
+    gc = np.atleast_1d(equirectangular_m(lat[:-1], lon[:-1],
+                                         lat[1:], lon[1:])).astype(np.float32)
+    return cands, gc, np.diff(tm)
+
+
+def _traces(city, n, seed=21):
+    rng = np.random.default_rng(seed)
+    out = []
+    while len(out) < n:
+        tr = generate_trace(city, f"rc-{len(out)}", rng, noise_m=4.0,
+                            min_route_edges=3, max_route_edges=10)
+        if tr is not None and len(tr.points) >= 3:
+            out.append(tr)
+    return out
+
+
+def test_pair_cache_matches_uncached_at_any_capacity(city):
+    grid = SpatialGrid(city, cell_m=75.0)
+    traces = _traces(city, 6)
+    kwargs = dict(backward_tolerance_m=25.0, max_route_time_factor=2.0,
+                  min_time_bound_s=15.0, turn_penalty_factor=120.0)
+    tensors = [_trace_tensors(city, grid, tr) for tr in traces]
+    want = [candidate_route_matrices(city, c, gc, cache=None, dt=dt,
+                                     **kwargs)
+            for c, gc, dt in tensors]
+    for max_pairs in (1, 7, 1 << 20):  # pathological .. generous
+        cache = RouteCache(city, max_pairs=max_pairs)
+        for _round in range(2):  # second round re-reads cached pairs
+            for (c, gc, dt), w in zip(tensors, want):
+                got = candidate_route_matrices(city, c, gc, cache=cache,
+                                               dt=dt, **kwargs)
+                np.testing.assert_array_equal(got, w)
+        assert len(cache._pairs) <= max_pairs  # eviction bound holds
+    assert cache.pair_hits > 0
+
+
+def test_node_cache_lru_bound(city):
+    cache = RouteCache(city, max_nodes=3)
+    for node in range(8):
+        cache.distances_from(node, 500.0)
+    assert len(cache._cache) <= 3
+    # evicted entries recompute correctly
+    d = cache.distances_from(0, 500.0)
+    assert d[0] == (0.0, 0.0)
+
+
+def test_cache_counters_reach_metrics(city):
+    metrics.default.reset()
+    grid = SpatialGrid(city, cell_m=75.0)
+    (tr,) = _traces(city, 1, seed=5)
+    c, gc, dt = _trace_tensors(city, grid, tr)
+    cache = RouteCache(city)
+    candidate_route_matrices(city, c, gc, cache=cache, dt=dt,
+                             max_route_time_factor=2.0)
+    candidate_route_matrices(city, c, gc, cache=cache, dt=dt,
+                             max_route_time_factor=2.0)
+    counters = metrics.snapshot()["counters"]
+    assert counters.get("route.cache.pair_misses", 0) > 0
+    assert counters.get("route.cache.pair_hits", 0) > 0
+    # flush is delta-based: totals match the cache's own counts
+    assert counters["route.cache.pair_hits"] == cache.pair_hits
+    assert counters["route.cache.pair_misses"] == cache.pair_misses
+
+
+def test_segment_ids_identical_cache_on_off_128_traces(city):
+    """ISSUE acceptance: a 128-trace synthetic-city run through the numpy
+    matcher produces identical segment IDs with the cross-batch cache
+    warm (second pass over the same traces) and with it effectively off
+    (capacity 1 — every lookup evicted immediately)."""
+    traces = _traces(city, 128, seed=33)
+    reqs = []
+    for tr in traces:
+        r = tr.request_json()
+        r["trace"] = tr.points[:16]
+        r["match_options"] = {"mode": "auto", "report_levels": [0, 1, 2],
+                              "transition_levels": [0, 1, 2]}
+        reqs.append(r)
+    tb = TraceBatch.from_requests(reqs)
+
+    def seg_ids(matches):
+        return [[s.get("segment_id") for s in m["segments"]]
+                for m in matches]
+
+    m_on = SegmentMatcher(net=city, params=MatchParams(),
+                          use_native=False)
+    first = seg_ids(m_on.match_many(tb))
+    warm = seg_ids(m_on.match_many(tb))  # cross-batch: cache fully warm
+    assert warm == first
+    assert m_on.route_cache.pair_hits > 0, "second pass must hit the cache"
+
+    m_off = SegmentMatcher(net=city, params=MatchParams(),
+                           use_native=False)
+    m_off._route_cache = RouteCache(city, max_nodes=1, max_pairs=1)
+    off = seg_ids(m_off.match_many(tb))
+    assert off == first

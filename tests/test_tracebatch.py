@@ -1,0 +1,136 @@
+"""Columnar TraceBatch: the zero-dict ingestion contract.
+
+Every ingestion edge (service, streaming worker, batch pipeline, bench)
+now hands the matcher one TraceBatch instead of request dicts; these
+tests pin (a) the dict-view compatibility surface report() and the tile
+emitters rely on, (b) the ragged gather the matcher's chunking uses, and
+(c) end-to-end equality: match_many over a TraceBatch must return
+byte-identical results to match_many over the request dicts it came
+from, on both the native and numpy paths.
+"""
+import numpy as np
+import pytest
+
+from reporter_tpu import native
+from reporter_tpu.core.tracebatch import (TraceBatch, as_trace_batch,
+                                          points_to_columns)
+from reporter_tpu.matcher import MatchParams, SegmentMatcher
+from reporter_tpu.synth import build_grid_city, generate_trace
+
+
+@pytest.fixture(scope="module")
+def city():
+    return build_grid_city(rows=8, cols=8, spacing_m=200.0, seed=3)
+
+
+@pytest.fixture(scope="module")
+def reqs(city):
+    rng = np.random.default_rng(17)
+    out = []
+    while len(out) < 10:
+        tr = generate_trace(city, f"tb-{len(out)}", rng, noise_m=4.0,
+                            min_route_edges=3, max_route_edges=12)
+        if tr is None or len(tr.points) < 4:
+            continue
+        r = tr.request_json()
+        r["trace"] = tr.points
+        r["match_options"] = {"mode": "auto", "report_levels": [0, 1, 2],
+                              "transition_levels": [0, 1, 2]}
+        out.append(r)
+    return out
+
+
+def test_points_to_columns_roundtrip(reqs):
+    pts = reqs[0]["trace"]
+    lat, lon, tm, acc = points_to_columns(pts)
+    assert lat.tolist() == [p["lat"] for p in pts]
+    assert lon.tolist() == [p["lon"] for p in pts]
+    assert tm.tolist() == [p["time"] for p in pts]
+    assert acc is not None
+    assert acc.astype(int).tolist() == [p["accuracy"] for p in pts]
+
+
+def test_from_requests_views(reqs):
+    tb = TraceBatch.from_requests(reqs)
+    assert len(tb) == len(reqs)
+    for i, req in enumerate(reqs):
+        view = tb[i]
+        assert view["uuid"] == req["uuid"]
+        assert view["match_options"] == req["match_options"]
+        pts = view["trace"]
+        assert len(pts) == len(req["trace"])
+        # first/last/negative indexing, the report() access pattern
+        assert pts[-1]["time"] == req["trace"][-1]["time"]
+        assert pts[0]["lat"] == pytest.approx(req["trace"][0]["lat"])
+        with pytest.raises(IndexError):
+            pts[len(pts)]
+        # slicing + iteration materialise point dicts lazily
+        assert [p["time"] for p in pts[:2]] == \
+            [p["time"] for p in req["trace"][:2]]
+        assert view.get("missing-key") is None
+        assert "trace" in view and "missing-key" not in view
+
+
+def test_gather_reorders_and_slices(reqs):
+    tb = TraceBatch.from_requests(reqs)
+    idx = [7, 0, 3, 3]  # out of order, with a repeat
+    sub = tb.gather(idx)
+    assert len(sub) == 4
+    for row, i in enumerate(idx):
+        lat, lon, tm = sub.trace_columns(row)
+        want_lat, want_lon, want_tm = tb.trace_columns(i)
+        np.testing.assert_array_equal(lat, want_lat)
+        np.testing.assert_array_equal(lon, want_lon)
+        np.testing.assert_array_equal(tm, want_tm)
+        assert sub.uuid(row) == tb.uuid(i)
+        assert sub.option(row) == tb.option(i)
+
+
+def test_concat_collapses_shared_options():
+    shared = {"mode": "auto"}
+    parts = [(f"u{i}", np.zeros(2), np.zeros(2), np.arange(2.0),
+              np.zeros(2, np.float32), shared) for i in range(3)]
+    tb = TraceBatch.concat(parts)
+    assert tb.options is shared  # one object for the whole batch
+    mixed = parts[:2] + [("u2", np.zeros(2), np.zeros(2), np.arange(2.0),
+                          np.zeros(2, np.float32), {"mode": "auto"})]
+    tb2 = TraceBatch.concat(mixed)
+    assert isinstance(tb2.options, list)  # equal values, distinct objects
+
+
+def test_to_request_materialises_dicts(reqs):
+    tb = TraceBatch.from_requests(reqs)
+    back = tb[2].to_request()
+    assert back["uuid"] == reqs[2]["uuid"]
+    assert back["match_options"] == reqs[2]["match_options"]
+    assert len(back["trace"]) == len(reqs[2]["trace"])
+    assert back["trace"][0]["time"] == reqs[2]["trace"][0]["time"]
+
+
+@pytest.mark.parametrize("use_native", [True, False])
+def test_match_many_tracebatch_equals_dicts(city, reqs, use_native):
+    if use_native and not native.available():
+        pytest.skip("native toolchain unavailable")
+    m = SegmentMatcher(net=city, params=MatchParams(),
+                       use_native=use_native)
+    want = m.match_many(reqs)
+    got = m.match_many(as_trace_batch(reqs))
+    assert got == want
+    # shared-options fast path: same batch with ONE options object
+    tb = TraceBatch.from_requests(reqs)
+    tb.options = reqs[0]["match_options"]
+    assert m.match_many(tb) == want
+
+
+def test_match_many_mixed_options_split(city, reqs):
+    """Per-trace options that change prep params must group correctly
+    through the TraceBatch path too (results align per index)."""
+    m = SegmentMatcher(net=city, params=MatchParams())
+    varied = [dict(r) for r in reqs]
+    for j in range(0, len(varied), 2):
+        varied[j] = dict(varied[j])
+        varied[j]["match_options"] = dict(varied[j]["match_options"],
+                                          search_radius=35.0)
+    want = m.match_many(varied)
+    got = m.match_many(TraceBatch.from_requests(varied))
+    assert got == want

@@ -1,0 +1,75 @@
+#!/usr/bin/env python
+"""CI smoke gate for the bench artifact's stage accounting.
+
+Runs a deliberately tiny CPU-pinned bench (seconds, not minutes — no
+accelerator probes, one repeat) and asserts the JSON contract future
+tooling depends on: the artifact parses, carries the ``stages``
+breakdown with the ``prep`` stage and its ``prep_share`` of batch wall
+time, and records whether the chunked overlap path ran (``pipelined``).
+A regression in stage accounting — a renamed timer, a dropped share
+field, an artifact that stops being one JSON line — fails CI here
+instead of silently degrading the committed BENCH artifacts.
+"""
+import json
+import os
+import subprocess
+import sys
+
+REQUIRED_TOP = ("metric", "value", "unit", "vs_baseline", "stages",
+                "baseline", "probe")
+REQUIRED_STAGES = ("prep", "decode_dispatch", "decode_wait", "assemble",
+                   "report", "total", "prep_share", "pipelined")
+
+
+def main() -> int:
+    env = dict(
+        os.environ,
+        REPORTER_TPU_PLATFORM="cpu",  # never contend for the chip in CI
+        BENCH_TRACES="48",
+        BENCH_BASELINE_TRACES="8",
+        BENCH_REPEATS="1",
+        BENCH_BASELINE_REPEATS="1",
+        BENCH_PALLAS="0",
+    )
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run([sys.executable, os.path.join(here, "bench.py")],
+                          capture_output=True, text=True, timeout=900,
+                          env=env, cwd=here)
+    if proc.returncode != 0:
+        sys.stderr.write(proc.stderr[-2000:] + "\n")
+        sys.stderr.write(f"bench smoke: bench.py rc={proc.returncode}\n")
+        return 1
+    lines = [ln for ln in proc.stdout.strip().splitlines() if ln.strip()]
+    if not lines:
+        sys.stderr.write("bench smoke: no output\n")
+        return 1
+    try:
+        art = json.loads(lines[-1])
+    except json.JSONDecodeError as e:
+        sys.stderr.write(f"bench smoke: artifact is not JSON: {e}\n")
+        return 1
+    missing = [k for k in REQUIRED_TOP if k not in art]
+    stages = art.get("stages", {})
+    missing += [f"stages.{k}" for k in REQUIRED_STAGES if k not in stages]
+    if missing:
+        sys.stderr.write(f"bench smoke: missing keys: {missing}\n")
+        return 1
+    if not isinstance(stages["pipelined"], bool):
+        sys.stderr.write("bench smoke: stages.pipelined must be a bool\n")
+        return 1
+    share = stages["prep_share"]
+    # prep runs on the main thread, so its seconds are bounded by wall
+    if not (isinstance(share, float) and 0.0 <= share <= 1.0):
+        sys.stderr.write(
+            f"bench smoke: stages.prep_share out of range: {share}\n")
+        return 1
+    if not (art["value"] > 0 and art["vs_baseline"] > 0):
+        sys.stderr.write("bench smoke: non-positive throughput\n")
+        return 1
+    print(f"bench smoke ok: {art['value']} traces/sec, "
+          f"prep_share={share}, pipelined={stages['pipelined']}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
