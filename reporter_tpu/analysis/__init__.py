@@ -1,0 +1,48 @@
+"""reporter-lint: project-native static analysis for the framework.
+
+Four AST-based passes pin the invariants the codebase depends on but no
+general-purpose tool can see:
+
+  hotpath      HP001-HP003  the columnar host pipeline stays columnar
+  jit_hygiene  JH001-JH003  jitted regions stay device-pure
+  abi          ABI001-ABI005 the ctypes binding mirrors host_runtime.cpp
+  locks        LD001        lock-guarded state is guarded at every write
+
+Driver: ``python tools/lint.py`` (CI ``lint`` stage; ``--abi-only`` is
+the pre-commit ABI guard). Suppress a documented false positive with a
+``# lint: ignore[RULE-ID]`` comment on the line (or the line above), or
+record it in the committed baseline (``tools/lint_baseline.txt``). See
+README "Static analysis" for the rule catalogue and workflow.
+
+This package imports nothing heavy (no jax, no numpy at analysis time
+beyond the stdlib ``ast``) so the lint stage starts fast and runs on
+hosts with no accelerator stack.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+from . import abi, hotpath, jit_hygiene, locks
+from .core import (Finding, SourceFile, collect_py_files, compare_baseline,
+                   filter_suppressed, load_baseline)
+
+#: the code passes, in report order (abi runs separately on its file pair)
+CODE_PASSES = (hotpath, jit_hygiene, locks)
+
+ALL_RULES: Dict[str, str] = {}
+for _p in (*CODE_PASSES, abi):
+    ALL_RULES.update(_p.RULES)
+
+
+def run_code_passes(files: Sequence[SourceFile],
+                    repo_root: str) -> List[Finding]:
+    findings: List[Finding] = []
+    for p in CODE_PASSES:
+        findings.extend(p.run(files, repo_root))
+    return sorted(filter_suppressed(findings, files))
+
+
+__all__ = ["Finding", "SourceFile", "collect_py_files", "load_baseline",
+           "compare_baseline", "filter_suppressed", "run_code_passes",
+           "CODE_PASSES", "ALL_RULES", "abi", "hotpath", "jit_hygiene",
+           "locks"]

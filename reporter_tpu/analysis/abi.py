@@ -1,0 +1,316 @@
+"""ctypes <-> C++ ABI cross-check (ABI rules).
+
+``native/__init__.py`` calls ``host_runtime.cpp`` through a hand-written
+flat C ABI: every ``lib.rt_*.argtypes`` list must mirror the C
+signature's arity, order and widths exactly, and the ``ABI_VERSION``
+handshake constant must equal ``rt_abi_version()``'s return. ctypes
+checks none of this — a drifted binding passes the wrong argument list
+and corrupts memory (the round-2 snapshot segfault). The handshake
+catches *half-landed* changes (library and binding from different
+commits); this pass catches the other half: both sides landed in one
+commit, wrong.
+
+The C side is parsed with a deliberately narrow grammar (the ``rt_*``
+export style host_runtime.cpp actually uses); the Python side by AST,
+resolving the ndpointer aliases (``c_i32p`` ...) and ``ctypes.POINTER``
+wrappers.
+
+ABI001  export/binding missing on one side
+ABI002  argument-count mismatch
+ABI003  argument type/order mismatch at a position
+ABI004  ABI_VERSION constant != rt_abi_version() return
+ABI005  return-type mismatch (an unset restype on a void function is
+        accepted: ctypes' default c_int return is ignored by callers)
+
+Width model: pointers match on pointee width (f64*, i32*, ...); the
+8-bit class is one width (``uint8_t*`` binds as either ``c_char_p`` for
+bytes or an ndpointer(uint8) for arrays); ``void*`` matches only
+``c_void_p``. Scalars must match exactly.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .core import Finding
+
+RULES = {
+    "ABI001": "native export/binding missing on one side",
+    "ABI002": "argtypes arity differs from the C signature",
+    "ABI003": "argtype width/order differs from the C signature",
+    "ABI004": "ABI_VERSION constant differs from rt_abi_version()",
+    "ABI005": "restype differs from the C return type",
+}
+
+DEFAULT_CPP = "reporter_tpu/native/src/host_runtime.cpp"
+DEFAULT_PY = "reporter_tpu/native/__init__.py"
+
+# (kind, width): kind 'ptr' | 'val'; width 'f64' 'f32' 'i64' 'i32' 'u16'
+# 'u8' 'i8' 'void'
+CType = Tuple[str, str]
+
+_C_WIDTHS = {
+    "double": "f64", "float": "f32", "int64_t": "i64", "int32_t": "i32",
+    "uint16_t": "u16", "uint8_t": "u8", "char": "i8", "void": "void",
+    "int": "i32", "long": "i64", "size_t": "i64", "uint32_t": "i32",
+    "uint64_t": "i64", "int8_t": "i8", "bool": "u8",
+}
+
+# longer alternatives first (int64_t before int, etc.); any type may
+# carry a pointer star so typed-pointer returns stay visible to ABI001
+_SIG_RE = re.compile(
+    r"^\s*(?:const\s+)?"
+    r"((?:int64_t|int32_t|int8_t|uint64_t|uint32_t|uint16_t|uint8_t"
+    r"|size_t|long|int|double|float|bool|char|void)\s*\*?)"
+    r"\s*(rt_\w+)\s*\(([^;{)]*)\)\s*\{",
+    re.MULTILINE)
+
+_VERSION_RE = re.compile(
+    r"rt_abi_version\s*\(\s*void\s*\)\s*\{\s*return\s+(\d+)\s*;")
+
+_NDP_DTYPES = {
+    "float64": "f64", "float32": "f32", "int64": "i64", "int32": "i32",
+    "uint16": "u16", "uint8": "u8", "int8": "i8", "float16": "u16",
+}
+
+_CTYPES_SCALARS = {
+    "c_double": ("val", "f64"), "c_float": ("val", "f32"),
+    "c_int64": ("val", "i64"), "c_int32": ("val", "i32"),
+    "c_int": ("val", "i32"), "c_uint8": ("val", "u8"),
+    "c_uint16": ("val", "u16"), "c_int8": ("val", "i8"),
+    "c_longlong": ("val", "i64"), "c_size_t": ("val", "i64"),
+    "c_bool": ("val", "u8"),
+    "c_void_p": ("ptr", "void"), "c_char_p": ("ptr", "i8"),
+}
+
+
+def _strip_comments(text: str) -> str:
+    text = re.sub(r"/\*.*?\*/", "", text, flags=re.DOTALL)
+    return re.sub(r"//[^\n]*", "", text)
+
+
+def _parse_c_arg(raw: str) -> Optional[CType]:
+    raw = raw.strip()
+    if not raw or raw == "void":
+        return None
+    is_ptr = "*" in raw
+    tokens = [t for t in re.split(r"[\s\*]+", raw)
+              if t and t not in ("const", "restrict", "volatile", "struct")]
+    # drop the parameter name (last token unless it is itself the type)
+    type_tokens = [t for t in tokens if t in _C_WIDTHS]
+    if not type_tokens:
+        return ("val", f"?{raw}")
+    width = _C_WIDTHS[type_tokens[0]]
+    return ("ptr" if is_ptr else "val", width)
+
+
+def parse_cpp(text: str) -> Tuple[Dict[str, Tuple[CType, List[CType]]],
+                                  Optional[int]]:
+    """{export name: (return, [args])}, abi version."""
+    text = _strip_comments(text)
+    out: Dict[str, Tuple[CType, List[CType]]] = {}
+    for m in _SIG_RE.finditer(text):
+        ret_raw, name, args_raw = m.groups()
+        ret_width = _C_WIDTHS[ret_raw.replace("*", "").strip()]
+        ret = ("ptr", ret_width) if "*" in ret_raw \
+            else ("val", ret_width)
+        args: List[CType] = []
+        if args_raw.strip() and args_raw.strip() != "void":
+            for part in args_raw.split(","):
+                a = _parse_c_arg(part)
+                if a is not None:
+                    args.append(a)
+        out[name] = (ret, args)
+    vm = _VERSION_RE.search(text)
+    return out, (int(vm.group(1)) if vm else None)
+
+
+# ---- Python (ctypes) side --------------------------------------------------
+
+def _ndpointer_width(call: ast.Call) -> Optional[str]:
+    if not call.args:
+        return None
+    d = _last_attr(call.args[0])
+    return _NDP_DTYPES.get(d or "")
+
+
+def _last_attr(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _classify_py(node: ast.AST, aliases: Dict[str, CType]) -> CType:
+    """ctypes argtype expression -> (kind, width); unknown -> ('?', repr)."""
+    if isinstance(node, ast.Name):
+        got = aliases.get(node.id)
+        if got is not None:
+            return got
+        got = _CTYPES_SCALARS.get(node.id)
+        if got is not None:
+            return got
+        return ("?", node.id)
+    if isinstance(node, ast.Attribute):
+        got = _CTYPES_SCALARS.get(node.attr)
+        if got is not None:
+            return got
+        return ("?", node.attr)
+    if isinstance(node, ast.Call):
+        leaf = _last_attr(node.func)
+        if leaf == "POINTER" and node.args:
+            inner = _classify_py(node.args[0], aliases)
+            return ("ptr", inner[1])
+        if leaf == "ndpointer":
+            w = _ndpointer_width(node)
+            if w:
+                return ("ptr", w)
+        return ("?", ast.dump(node)[:40])
+    if isinstance(node, ast.Constant) and node.value is None:
+        return ("val", "void")  # restype = None: explicit void
+    return ("?", type(node).__name__)
+
+
+class _PyBindings(ast.NodeVisitor):
+    """argtypes/restype assignments + ABI_VERSION from the binding module."""
+
+    def __init__(self):
+        self.aliases: Dict[str, CType] = {}
+        self.argtypes: Dict[str, Tuple[int, List[CType]]] = {}
+        self.restype: Dict[str, Tuple[int, CType]] = {}
+        self.version: Optional[int] = None
+        self.version_line = 0
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        target = node.targets[0] if len(node.targets) == 1 else None
+        # alias definitions: c_i32p = np.ctypeslib.ndpointer(np.int32, ...)
+        # and i64ref = ctypes.POINTER(ctypes.c_int64)
+        if isinstance(target, ast.Name):
+            if isinstance(node.value, ast.Call):
+                got = _classify_py(node.value, self.aliases)
+                if got[0] != "?":
+                    self.aliases[target.id] = got
+            elif target.id == "ABI_VERSION" \
+                    and isinstance(node.value, ast.Constant) \
+                    and isinstance(node.value.value, int):
+                self.version = node.value.value
+                self.version_line = node.lineno
+        # lib.rt_x.argtypes = [...] / lib.rt_x.restype = ...
+        if isinstance(target, ast.Attribute) \
+                and isinstance(target.value, ast.Attribute) \
+                and target.value.attr.startswith("rt_"):
+            fname = target.value.attr
+            if target.attr == "argtypes":
+                elems = node.value.elts \
+                    if isinstance(node.value, (ast.List, ast.Tuple)) else []
+                self.argtypes[fname] = (
+                    node.lineno,
+                    [_classify_py(e, self.aliases) for e in elems])
+            elif target.attr == "restype":
+                self.restype[fname] = (
+                    node.lineno, _classify_py(node.value, self.aliases))
+        self.generic_visit(node)
+
+
+def _compatible(c: CType, py: CType) -> bool:
+    ck, cw = c
+    pk, pw = py
+    if ck != pk:
+        return False
+    if ck == "ptr":
+        eight_bit = {"i8", "u8"}
+        if cw in eight_bit and pw in eight_bit:
+            return True
+    return cw == pw
+
+
+def _fmt(t: CType) -> str:
+    kind, width = t
+    return f"{width}*" if kind == "ptr" else width
+
+
+def check(cpp_text: str, py_text: str, cpp_rel: str = DEFAULT_CPP,
+          py_rel: str = DEFAULT_PY) -> List[Finding]:
+    """Cross-check one (host_runtime.cpp, native/__init__.py) pair."""
+    findings: List[Finding] = []
+    exports, c_version = parse_cpp(cpp_text)
+    pb = _PyBindings()
+    pb.visit(ast.parse(py_text, filename=py_rel))
+
+    if c_version is None:
+        findings.append(Finding(cpp_rel, 1, "ABI004",
+                                "rt_abi_version() not found in the C++ "
+                                "runtime"))
+    elif pb.version is None:
+        findings.append(Finding(py_rel, 1, "ABI004",
+                                "ABI_VERSION constant not found in the "
+                                "binding"))
+    elif c_version != pb.version:
+        findings.append(Finding(
+            py_rel, pb.version_line, "ABI004",
+            f"ABI_VERSION={pb.version} but rt_abi_version() returns "
+            f"{c_version} — bump both in the same commit"))
+
+    for name in sorted(set(exports) | set(pb.argtypes)):
+        if name not in exports:
+            line = pb.argtypes[name][0]
+            findings.append(Finding(
+                py_rel, line, "ABI001",
+                f"{name} has argtypes but no extern \"C\" definition in "
+                f"{cpp_rel}"))
+            continue
+        c_ret, c_args = exports[name]
+        if name not in pb.argtypes:
+            findings.append(Finding(
+                py_rel, 1, "ABI001",
+                f"extern \"C\" {name} has no argtypes binding — ctypes "
+                "would guess int-sized arguments"))
+            continue
+        line, py_args = pb.argtypes[name]
+        if len(c_args) != len(py_args):
+            findings.append(Finding(
+                py_rel, line, "ABI002",
+                f"{name}: {len(py_args)} argtypes vs {len(c_args)} C "
+                "parameters"))
+        else:
+            for i, (ca, pa) in enumerate(zip(c_args, py_args)):
+                if pa[0] == "?":
+                    findings.append(Finding(
+                        py_rel, line, "ABI003",
+                        f"{name} arg {i}: unresolvable argtype {pa[1]!r}"))
+                elif not _compatible(ca, pa):
+                    findings.append(Finding(
+                        py_rel, line, "ABI003",
+                        f"{name} arg {i}: binding passes {_fmt(pa)} but C "
+                        f"expects {_fmt(ca)}"))
+        # return type
+        got = pb.restype.get(name)
+        if c_ret == ("val", "void"):
+            if got is not None and got[1] != ("val", "void"):
+                findings.append(Finding(
+                    py_rel, got[0], "ABI005",
+                    f"{name}: restype set to {_fmt(got[1])} but C returns "
+                    "void"))
+        else:
+            if got is None:
+                findings.append(Finding(
+                    py_rel, line, "ABI005",
+                    f"{name}: C returns {_fmt(c_ret)} but restype is "
+                    "unset (ctypes truncates to c_int)"))
+            elif not _compatible(c_ret, got[1]):
+                findings.append(Finding(
+                    py_rel, got[0], "ABI005",
+                    f"{name}: restype {_fmt(got[1])} but C returns "
+                    f"{_fmt(c_ret)}"))
+    return findings
+
+
+def run_paths(cpp_path: str, py_path: str, cpp_rel: str,
+              py_rel: str) -> List[Finding]:
+    with open(cpp_path, encoding="utf-8") as f:
+        cpp_text = f.read()
+    with open(py_path, encoding="utf-8") as f:
+        py_text = f.read()
+    return check(cpp_text, py_text, cpp_rel, py_rel)

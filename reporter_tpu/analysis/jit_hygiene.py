@@ -1,0 +1,378 @@
+"""JIT hygiene pass (JH rules).
+
+The decode kernels are only correct-and-fast while the code inside their
+traced regions stays device-pure: a numpy call on a tracer forces a host
+sync (ConcretizationError at best, a silent d2h round trip at worst), a
+dtype-less array constructor drifts with the x64 flag and weak-type
+promotion, and a Python branch on tensor *values* retraces per value (or
+throws under jit). Nothing in the type system marks "this function runs
+under trace" — so this pass computes it: every function reachable from a
+``jax.jit``/``pjit``/``pallas_call`` entry point through module-level
+calls and closure references is a jit region.
+
+JH001  host sync inside a jit region: ``np.*`` calls, ``jax.device_get``,
+       ``.block_until_ready()``, ``.item()``/``.tolist()``, and builtin
+       ``float()``/``int()``/``bool()`` casts of non-literal values (all
+       concretise tracers).
+JH002  dtype drift inside a jit region: ``jnp`` array constructors with
+       no ``dtype=`` (platform/x64-flag dependent, and Python-scalar
+       arrays stay weak-typed, promoting against the declared compute
+       dtype — matcher/hmm.py scores everything in f32), plus
+       ``.astype(float)``/``.astype(int)`` with Python builtin types.
+JH003  data-dependent Python branching inside a jit region: ``if``/
+       ``while``/ternary tests referencing a traced parameter's *values*.
+       Shape/dtype attribute access (``x.shape``, ``x.ndim``, ...) and
+       ``len()``/``isinstance()`` are static under trace and exempt —
+       ``trim_time_pad``'s shape branch is the sanctioned pattern.
+
+Known approximations (documented, not bugs): reachability follows names —
+a function referenced but never called from a jit region is still
+scanned; locals assigned from tracers are not tracked (parameters are).
+Both err toward flagging, with suppressions as the escape hatch.
+"""
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .core import Finding, SourceFile, dotted
+
+RULES = {
+    "JH001": "host sync inside a jit-traced region",
+    "JH002": "dtype-less constructor / weak-type promotion in a jit region",
+    "JH003": "data-dependent Python branch inside a jit-traced region",
+}
+
+_SHAPE_ATTRS = frozenset({"shape", "ndim", "dtype", "size", "sharding",
+                          "weak_type", "itemsize", "nbytes"})
+_STATIC_CALLS = frozenset({"len", "isinstance", "hasattr", "getattr",
+                           "type", "range"})
+# jnp constructors and the 0-based positional index of their dtype
+# parameter (flagged when dtype is passed neither by keyword nor
+# positionally, i.e. len(args) <= index)
+_CTOR_DTYPE_POS = {
+    "array": 1, "asarray": 1, "zeros": 1, "ones": 1, "empty": 1,
+    "full": 2, "arange": 3, "linspace": 5, "eye": 3, "identity": 1,
+}
+
+
+class _Module:
+    """Per-file symbol info: top-level functions, import aliases."""
+
+    def __init__(self, sf: SourceFile):
+        self.sf = sf
+        self.name = _module_name(sf.relpath)
+        self.functions: Dict[str, ast.FunctionDef] = {}
+        self.import_alias: Dict[str, str] = {}        # alias -> module path
+        self.import_from: Dict[str, Tuple[str, str]] = {}  # alias -> (mod, sym)
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.import_alias[a.asname or a.name.split(".")[0]] = \
+                        a.name if a.asname else a.name.split(".")[0]
+            elif isinstance(node, ast.ImportFrom):
+                base = self._resolve_from(node)
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    self.import_from[a.asname or a.name] = (base, a.name)
+        for node in sf.tree.body:  # top level only
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.functions[node.name] = node
+
+    def _resolve_from(self, node: ast.ImportFrom) -> str:
+        if not node.level:
+            return node.module or ""
+        # current package: the module's parent (itself for __init__)
+        parts = self.name.split(".")
+        if not self.sf.relpath.endswith("__init__.py"):
+            parts = parts[:-1]
+        parts = parts[:len(parts) - (node.level - 1)] if node.level > 1 \
+            else parts
+        if node.module:
+            parts = parts + node.module.split(".")
+        return ".".join(parts)
+
+    def alias_roots(self, *targets: str) -> Set[str]:
+        """Local names bound to any of the given module paths."""
+        out = set()
+        for alias, mod in self.import_alias.items():
+            if mod in targets:
+                out.add(alias)
+        for alias, (mod, sym) in self.import_from.items():
+            if f"{mod}.{sym}" in targets:
+                out.add(alias)
+        return out
+
+
+def _module_name(relpath: str) -> str:
+    p = relpath[:-3] if relpath.endswith(".py") else relpath
+    parts = p.replace(os.sep, "/").split("/")
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+def _static_argnames(call: ast.Call) -> Set[str]:
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                return {v.value}
+            if isinstance(v, (ast.Tuple, ast.List)):
+                return {e.value for e in v.elts
+                        if isinstance(e, ast.Constant)
+                        and isinstance(e.value, str)}
+    return set()
+
+
+def _jit_like(expr: ast.AST, mod: _Module) -> Optional[Set[str]]:
+    """If ``expr`` is a jit-wrapping expression (jax.jit, pjit, a
+    functools.partial around one), return its static_argnames; else None."""
+    d = dotted(expr)
+    jax_names = mod.alias_roots("jax")
+    if d is not None:
+        head = d.split(".")[0]
+        if d.split(".")[-1] in ("jit", "pjit") and (
+                head in jax_names or head in ("jax", "pjit")
+                or d in ("jit", "pjit")):
+            return set()
+        # a bare decorator name imported from jax: `from jax import jit`
+        tgt = mod.import_from.get(d)
+        if tgt is not None and tgt[0].startswith("jax") \
+                and tgt[1] in ("jit", "pjit"):
+            return set()
+    if isinstance(expr, ast.Call):
+        d = dotted(expr.func)
+        if d is not None and d.split(".")[-1] == "partial" and expr.args:
+            inner = _jit_like(expr.args[0], mod)
+            if inner is not None:
+                return inner | _static_argnames(expr)
+        inner = _jit_like(expr.func, mod)
+        if inner is not None:  # @jax.jit(...) with options
+            return inner | _static_argnames(expr)
+    return None
+
+
+def _first_func_ref(expr: ast.AST) -> Optional[str]:
+    """Name the expression refers to: ``f``, ``f.__wrapped__``."""
+    if isinstance(expr, ast.Name):
+        return expr.id
+    if isinstance(expr, ast.Attribute) and expr.attr == "__wrapped__" \
+            and isinstance(expr.value, ast.Name):
+        return expr.value.id
+    return None
+
+
+def _find_entries(mod: _Module) -> Dict[str, Set[str]]:
+    """{function name: static_argnames} for this module's jit entry points."""
+    entries: Dict[str, Set[str]] = {}
+    pallas_roots = mod.alias_roots("jax.experimental.pallas")
+    for node in ast.walk(mod.sf.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                statics = _jit_like(dec, mod)
+                if statics is not None:
+                    entries.setdefault(node.name, set()).update(statics)
+        elif isinstance(node, ast.Call):
+            d = dotted(node.func)
+            if d is None:
+                continue
+            statics = _jit_like(node.func, mod)
+            if statics is not None and node.args:
+                target = _first_func_ref(node.args[0])
+                if target:
+                    entries.setdefault(target, set()).update(
+                        statics | _static_argnames(node))
+            elif d.split(".")[-1] == "pallas_call" and node.args and (
+                    d.split(".")[0] in pallas_roots
+                    or "pallas" in d):
+                target = _first_func_ref(node.args[0])
+                if target:
+                    entries.setdefault(target, set())
+    return entries
+
+
+def _referenced_names(func: ast.AST) -> Set[str]:
+    return {n.id for n in ast.walk(func) if isinstance(n, ast.Name)}
+
+
+def _collect_regions(files: Sequence[SourceFile]
+                     ) -> List[Tuple[_Module, ast.AST, Set[str]]]:
+    """(module, function node, static_argnames) for every jit region,
+    following references across the scanned package."""
+    mods = {m.name: m for m in (_Module(sf) for sf in files)}
+    work: List[Tuple[str, str, Set[str]]] = []
+    for m in mods.values():
+        for fname, statics in _find_entries(m).items():
+            work.append((m.name, fname, statics))
+    seen: Set[Tuple[str, str]] = set()
+    regions: List[Tuple[_Module, ast.AST, Set[str]]] = []
+    while work:
+        mname, fname, statics = work.pop()
+        if (mname, fname) in seen:
+            continue
+        seen.add((mname, fname))
+        mod = mods.get(mname)
+        if mod is None:
+            continue
+        func = mod.functions.get(fname)
+        if func is None:
+            # an entry naming an imported symbol (e.g. jax.jit applied to
+            # a function imported from another scanned module)
+            tgt = mod.import_from.get(fname)
+            if tgt is not None and tgt[0] in mods:
+                work.append((tgt[0], tgt[1], statics))
+            continue
+        regions.append((mod, func, statics))
+        for ref in _referenced_names(func):
+            if ref in mod.functions:
+                work.append((mname, ref, set()))
+            elif ref in mod.import_from:
+                tmod, tsym = mod.import_from[ref]
+                if tmod in mods:
+                    work.append((tmod, tsym, set()))
+    return regions
+
+
+class _RegionVisitor(ast.NodeVisitor):
+    """Applies JH rules inside one jit region's subtree."""
+
+    def __init__(self, mod: _Module, statics: Set[str]):
+        self.mod = mod
+        self.statics = statics
+        self.findings: List[Finding] = []
+        self.np_roots = mod.alias_roots("numpy")
+        self.jnp_roots = mod.alias_roots("jax.numpy")
+        self.jax_roots = mod.alias_roots("jax") | {"jax"}
+        self.tracers: List[Set[str]] = []
+
+    def _emit(self, rule: str, node: ast.AST, message: str) -> None:
+        self.findings.append(Finding(self.mod.sf.relpath, node.lineno,
+                                     rule, message))
+
+    # -- function scope ----------------------------------------------------
+    def run(self, func: ast.AST) -> List[Finding]:
+        self._visit_func(func)
+        return self.findings
+
+    def _params(self, func) -> Set[str]:
+        a = func.args
+        names = [p.arg for p in (*a.posonlyargs, *a.args, *a.kwonlyargs)]
+        if a.vararg:
+            names.append(a.vararg.arg)
+        return {n for n in names
+                if n not in self.statics and n not in ("self", "cls")}
+
+    def _visit_func(self, func) -> None:
+        self.tracers.append(self._params(func))
+        body = func.body if isinstance(func.body, list) else [func.body]
+        for stmt in body:
+            self.visit(stmt)
+        self.tracers.pop()
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._visit_func(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef  # type: ignore[assignment]
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        self._visit_func(node)
+
+    # -- JH001 / JH002 -----------------------------------------------------
+    def visit_Call(self, node: ast.Call) -> None:
+        d = dotted(node.func)
+        if d is not None:
+            root, leaf = d.split(".")[0], d.split(".")[-1]
+            if root in self.np_roots or root == "numpy":
+                self._emit("JH001", node,
+                           f"numpy call {d}() on traced values forces a "
+                           "host sync (use jnp, or move it out of the "
+                           "jitted region)")
+            elif leaf == "device_get" and root in self.jax_roots:
+                self._emit("JH001", node,
+                           "jax.device_get inside a jitted region is a "
+                           "host sync")
+            elif (root in self.jnp_roots and leaf in _CTOR_DTYPE_POS
+                  and not any(kw.arg == "dtype" for kw in node.keywords)
+                  and len(node.args) <= _CTOR_DTYPE_POS[leaf]
+                  and node.args):
+                self._emit("JH002", node,
+                           f"{d}() without dtype= in a jitted region "
+                           "(platform/x64-dependent dtype; Python-scalar "
+                           "arrays stay weak-typed and promote against "
+                           "the declared compute dtype)")
+        if isinstance(node.func, ast.Attribute):
+            attr = node.func.attr
+            if attr == "block_until_ready":
+                self._emit("JH001", node,
+                           ".block_until_ready() inside a jitted region "
+                           "is a host sync")
+            elif attr in ("item", "tolist") and not node.args:
+                self._emit("JH001", node,
+                           f".{attr}() concretises a tracer (host sync)")
+            elif attr == "astype" and node.args:
+                arg = node.args[0]
+                if isinstance(arg, ast.Name) and arg.id in ("float", "int"):
+                    self._emit("JH002", node,
+                               f".astype({arg.id}) uses a Python builtin "
+                               "type (x64-flag-dependent width); name the "
+                               "jnp dtype explicitly")
+        elif isinstance(node.func, ast.Name) \
+                and node.func.id in ("float", "int", "bool") \
+                and node.args and not isinstance(node.args[0], ast.Constant):
+            if self._mentions_tracer(node.args[0]):
+                self._emit("JH001", node,
+                           f"builtin {node.func.id}() cast of a traced "
+                           "value concretises it (host sync)")
+        self.generic_visit(node)
+
+    # -- JH003 -------------------------------------------------------------
+    def _mentions_tracer(self, test: ast.AST) -> bool:
+        active = set().union(*self.tracers) if self.tracers else set()
+
+        def scan(n: ast.AST) -> bool:
+            if isinstance(n, ast.Attribute) and n.attr in _SHAPE_ATTRS:
+                return False  # anything under x.shape/.dtype/... is static
+            if isinstance(n, ast.Call):
+                d = dotted(n.func)
+                if d is not None and d.split(".")[-1] in _STATIC_CALLS:
+                    return False
+            if isinstance(n, ast.Name) and n.id in active:
+                return True
+            return any(scan(c) for c in ast.iter_child_nodes(n))
+
+        return scan(test)
+
+    def _check_branch(self, node, test: ast.AST, kind: str) -> None:
+        if self._mentions_tracer(test):
+            self._emit("JH003", node,
+                       f"{kind} on traced values retraces per value (or "
+                       "fails under jit); use jnp.where/lax.cond, or "
+                       "branch on .shape/.dtype only")
+
+    def visit_If(self, node: ast.If) -> None:
+        self._check_branch(node, node.test, "Python if")
+        self.generic_visit(node)
+
+    def visit_While(self, node: ast.While) -> None:
+        self._check_branch(node, node.test, "Python while")
+        self.generic_visit(node)
+
+    def visit_IfExp(self, node: ast.IfExp) -> None:
+        self._check_branch(node, node.test, "conditional expression")
+        self.generic_visit(node)
+
+    def visit_Assert(self, node: ast.Assert) -> None:
+        self._check_branch(node, node.test, "assert")
+        self.generic_visit(node)
+
+
+def run(files: Sequence[SourceFile], repo_root: str) -> List[Finding]:
+    # each function is visited exactly once: _collect_regions de-dups by
+    # (module, name) even when several entries reach the same helper
+    findings: List[Finding] = []
+    for mod, func, statics in _collect_regions(files):
+        findings.extend(_RegionVisitor(mod, statics).run(func))
+    return findings

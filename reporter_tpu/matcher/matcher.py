@@ -95,8 +95,10 @@ def _runs_as_lists(runs: dict) -> dict:
     return {
         "seg_id": runs["seg_id"].tolist(),
         "internal": runs["internal"].astype(bool).tolist(),
-        "start": runs["start"].tolist(),
-        "end": runs["end"].tolist(),
+        # round HERE, whole column at once: _format_runs used to call
+        # round() twice per run dict (reporter-lint HP002 sweep)
+        "start": np.round(runs["start"], 3).tolist(),
+        "end": np.round(runs["end"], 3).tolist(),
         "length": runs["length"].tolist(),
         "queue": runs["queue"].tolist(),
         "begin_idx": runs["begin_idx"].tolist(),
@@ -128,8 +130,8 @@ def _format_runs(cols: dict, lo: int, hi: int, mode: str) -> dict:
     for r in range(lo, hi):
         entry = {
             "way_ids": ways[way_off[r]:way_off[r + 1]],
-            "start_time": round(start[r], 3),
-            "end_time": round(end[r], 3),
+            "start_time": start[r],
+            "end_time": end[r],
             "length": length[r],
             "queue_length": queue[r],
             "internal": internal[r],
@@ -456,7 +458,10 @@ class SegmentMatcher:
                 bucket = idxs[Ts[idxs] == T]
                 for lo in range(0, len(bucket), chunk):
                     part = bucket[lo:lo + chunk]
-                    order = part.tolist()
+                    # part itself is the order: _drain_stage only
+                    # enumerates it, so no per-chunk list conversion
+                    # (reporter-lint HP003)
+                    order = part
                     rows = padded_batch_rows(len(part), pad)
                     with metrics.timer("matcher.prep"):
                         batch = prepare_batch(
@@ -480,7 +485,10 @@ class SegmentMatcher:
                     prepped = prepare_traces_numpy(
                         self.net, self.grid, tb.gather(part), params,
                         self.route_cache)
-                idx_of = {id(p): i for p, i in zip(prepped, part.tolist())}
+                # chunk-granular identity bookkeeping on the numpy
+                # fallback path (one small dict per chunk, not per point)
+                idx_of = {id(p): i  # lint: ignore[HP002]
+                          for p, i in zip(prepped, part)}
                 for batch in pack_batches(prepped, pad_batch_to=pad,
                                           pad_pow2=True):
                     # rows of a packed batch align with its traces list,

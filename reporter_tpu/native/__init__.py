@@ -37,35 +37,55 @@ _build_failed = False
 
 
 def _try_build() -> Optional[ctypes.CDLL]:
+    """Build (if stale) and load the library. Caller holds _build_lock."""
     global _build_failed
-    with _build_lock:
-        if _build_failed:
-            return None
-        src = os.path.join(_DIR, "src", "host_runtime.cpp")
+    if _build_failed:
+        return None
+    # sanitizer/CI override: load a pre-built library (e.g. the asan/ubsan
+    # targets of the Makefile) instead of the default build product; the
+    # ABI handshake below still applies to it
+    override = os.environ.get("REPORTER_TPU_NATIVE_LIB")
+    src = os.path.join(_DIR, "src", "host_runtime.cpp")
 
-        def build():
-            subprocess.run(["make", "-C", _DIR], check=True,
-                           capture_output=True, timeout=180)
+    def build():
+        subprocess.run(["make", "-C", _DIR], check=True,
+                       capture_output=True, timeout=180)
 
+    try:
+        if override:
+            return ctypes.CDLL(override)
+        if not (os.path.exists(_LIB_PATH) and os.path.exists(src)
+                and os.path.getmtime(_LIB_PATH) >= os.path.getmtime(src)):
+            build()
         try:
-            if not (os.path.exists(_LIB_PATH) and os.path.exists(src)
-                    and os.path.getmtime(_LIB_PATH) >= os.path.getmtime(src)):
-                build()
-            try:
-                return ctypes.CDLL(_LIB_PATH)
-            except OSError:
-                # a stale or foreign-platform .so can look up to date by
-                # mtime yet fail to load — rebuild once and retry
-                build()
-                return ctypes.CDLL(_LIB_PATH)
-        except Exception as e:
-            _build_failed = True
-            logger.warning("native host runtime unavailable (%s); "
-                           "falling back to numpy", e)
-            return None
+            return ctypes.CDLL(_LIB_PATH)
+        except OSError:
+            # a stale or foreign-platform .so can look up to date by
+            # mtime yet fail to load — rebuild once and retry
+            build()
+            return ctypes.CDLL(_LIB_PATH)
+    except Exception as e:
+        _build_failed = True
+        logger.warning("native host runtime unavailable (%s); "
+                       "falling back to numpy", e)
+        return None
 
 
 def _get_lib() -> Optional[ctypes.CDLL]:
+    # lock-free fast path: a published _lib is immutable from then on.
+    # The whole init — build, handshake, signature setup, publication —
+    # runs under _build_lock: the old flow published _lib and the sticky
+    # _build_failed flag OUTSIDE the lock while _try_build wrote the same
+    # flag inside it, so two first-callers could race a half-checked
+    # handle into the process (found by reporter-lint LD001).
+    if _lib is not None:
+        return _lib
+    with _build_lock:
+        return _init_locked()
+
+
+def _init_locked() -> Optional[ctypes.CDLL]:
+    """Build + handshake + signature setup; _build_lock held."""
     global _lib, _build_failed
     if _lib is None:
         lib = _try_build()

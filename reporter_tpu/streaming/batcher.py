@@ -121,11 +121,16 @@ class Batch:
                 trim_to = len(self.points)
             del self.points[:trim_to]
             self.max_separation = 0.0
-            first = self.points[0] if self.points else None
-            for p in self.points[1:]:
-                self.max_separation = max(
-                    self.max_separation,
-                    equirectangular_m(p.lat, p.lon, first.lat, first.lon))
+            pts = self.points
+            if len(pts) > 1:
+                # one columnar pass (reporter-lint HP001: the old
+                # per-point loop re-ran scalar equirectangular_m per
+                # surviving point on every trim)
+                n = len(pts)
+                lat = np.fromiter((p.lat for p in pts), np.float64, n)
+                lon = np.fromiter((p.lon for p in pts), np.float64, n)
+                self.max_separation = float(np.max(
+                    equirectangular_m(lat[1:], lon[1:], lat[0], lon[0])))
             return response
         except Exception:
             self.drop()

@@ -1,0 +1,29 @@
+"""Deliberately MISMATCHED binding for abi_good.cpp — every drift class
+the ABI pass must catch (parsed, never imported)."""
+import ctypes
+
+import numpy as np
+
+ABI_VERSION = 8        # ABI004: cpp returns 7
+
+
+def bind(lib):
+    c_i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+    c_f32p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
+    c_i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+    lib.rt_abi_version.restype = ctypes.c_int32
+    lib.rt_abi_version.argtypes = []
+    # ABI003: arg 1 must be f64* (ndpointer float64), not f32*
+    # ABI005: restype dropped — C returns void*
+    lib.rt_thing_create.argtypes = [
+        ctypes.c_int64, c_f32p, c_f32p, ctypes.c_double]
+    lib.rt_thing_destroy.argtypes = [ctypes.c_void_p]
+    # ABI002: out_scores missing (5 argtypes vs 6 C parameters)
+    # and arg 4 is i32* where C wants i64* (masked by the arity error)
+    lib.rt_thing_run.restype = ctypes.c_int64
+    lib.rt_thing_run.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, c_i32p, ctypes.c_char_p,
+        c_i64p]
+    # ABI001: no such export in the C++ fixture
+    lib.rt_thing_missing.argtypes = [ctypes.c_void_p]
+    return lib

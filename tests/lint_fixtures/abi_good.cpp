@@ -1,0 +1,26 @@
+// Mini host-runtime fixture: a matched extern "C" surface for the ABI
+// cross-check tests (never compiled — parsed by analysis/abi.py).
+#include <cstdint>
+
+extern "C" {
+
+int32_t rt_abi_version(void) { return 7; }
+
+void* rt_thing_create(int64_t n, const double* xs, const float* ws,
+                      double scale) {
+  (void)n; (void)xs; (void)ws; (void)scale;
+  return nullptr;
+}
+
+void rt_thing_destroy(void* handle) { (void)handle; }
+
+// multi-line signatures and 8-bit/64-bit pointer classes
+int64_t rt_thing_run(void* handle, int64_t count, const int32_t* ids,
+                     const uint8_t* flags, int64_t* out_vals,
+                     float* out_scores) {
+  (void)handle; (void)count; (void)ids; (void)flags;
+  (void)out_vals; (void)out_scores;
+  return 0;
+}
+
+}  // extern "C"

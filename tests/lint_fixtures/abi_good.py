@@ -1,0 +1,24 @@
+"""Matched ctypes binding for abi_good.cpp (parsed, never imported)."""
+import ctypes
+
+import numpy as np
+
+ABI_VERSION = 7
+
+
+def bind(lib):
+    c_i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+    c_f32p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
+    c_f64p = np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS")
+    c_i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+    lib.rt_abi_version.restype = ctypes.c_int32
+    lib.rt_abi_version.argtypes = []
+    lib.rt_thing_create.restype = ctypes.c_void_p
+    lib.rt_thing_create.argtypes = [
+        ctypes.c_int64, c_f64p, c_f32p, ctypes.c_double]
+    lib.rt_thing_destroy.argtypes = [ctypes.c_void_p]
+    lib.rt_thing_run.restype = ctypes.c_int64
+    lib.rt_thing_run.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, c_i32p, ctypes.c_char_p,
+        c_i64p, c_f32p]
+    return lib

@@ -1,0 +1,39 @@
+"""Known-BAD hot-path snippets: every marked line must fire.
+
+Parsed by reporter_tpu.analysis.hotpath under a fake hot-path relpath —
+never imported or executed (numpy-ish names are just names to the AST).
+"""
+
+
+def ingest(traces):
+    out = []
+    for req in traces:
+        for p in req["trace"]:          # HP001: per-element loop over trace
+            out.append(p["lat"])
+    return out
+
+
+def rebuild(points):
+    total = 0.0
+    for p in points:                    # HP001: per-element loop over points
+        total += p.lat
+    return total
+
+
+def format_rows(rows):
+    results = []
+    for r in rows:
+        entry = {"id": r, "v": r * 2}   # HP002: dict built inside a loop
+        results.append(entry)
+    return results
+
+
+def collect(arrs):
+    vals = []
+    for a in arrs:
+        vals.append(a.tolist())         # HP003: .tolist() in a loop body
+    return vals
+
+
+def scalarise(arr):
+    return arr[0].item()                # HP003: .item() extraction

@@ -1,0 +1,45 @@
+"""Known-GOOD hot-path snippets: the pass must stay silent here.
+
+The matching negatives for hotpath_bad.py — the approved columnar idioms
+for the same jobs.
+"""
+import numpy as np
+
+
+def ingest(traces):
+    # columnar: one bulk conversion, no per-point statement loop
+    counts = [len(r["trace"]) for r in traces]
+    lat = np.fromiter(
+        (p["lat"] for r in traces for p in r["trace"]),
+        np.float64, sum(counts))
+    return lat
+
+
+def rebuild_columnar(lat):
+    return float(np.sum(lat))
+
+
+def format_rows(rows):
+    # bulk convert ONCE, in the loop header (runs once) — then index
+    doubled = (rows * 2)
+    out = []
+    for r, v in zip(rows.tolist(), doubled.tolist()):
+        out.append((r, v))
+    return out
+
+
+def chunk_indices(idxs, chunk):
+    # loops over index ranges are structure, not trace data
+    parts = []
+    for lo in range(0, len(idxs), chunk):
+        parts.append(idxs[lo:lo + chunk])
+    return parts
+
+
+def suppressed_edge(rows):
+    results = []
+    for r in rows:
+        # a documented boundary may opt out explicitly:
+        entry = {"id": r}  # lint: ignore[HP002]
+        results.append(entry)
+    return results

@@ -1,0 +1,46 @@
+"""Known-BAD jit-hygiene snippets: every marked line must fire.
+
+AST-only fixture (never imported); the imports below exist so the pass's
+alias resolution sees the standard names.
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def host_sync(x):
+    y = np.asarray(x)                   # JH001: numpy call on a tracer
+    z = jax.device_get(y)               # JH001: device_get in jit
+    z.block_until_ready()               # JH001: host sync
+    return float(x) + x.item()          # JH001 (x2): float() cast + .item()
+
+
+@jax.jit
+def weak_types(x):
+    bias = jnp.array(0.5)               # JH002: dtype-less constructor
+    acc = jnp.zeros(x.shape[0])         # JH002: dtype-less constructor
+    return (x + bias + acc).astype(float)   # JH002: builtin float dtype
+
+
+@functools.partial(jax.jit, static_argnames=("flag",))
+def branches(x, flag):
+    if flag:                            # OK: static argument
+        x = x * 2
+    if x[0] > 0:                        # JH003: branch on traced values
+        x = x + 1
+    y = x - 1 if x.sum() > 0 else x     # JH003: ternary on traced values
+    return y
+
+
+def helper(v):
+    while v > 0:                        # JH003: reached from the entry below
+        v = v - 1
+    return v
+
+
+@jax.jit
+def entry_calls_helper(x):
+    return helper(x)

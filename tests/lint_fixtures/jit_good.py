@@ -1,0 +1,45 @@
+"""Known-GOOD jit-hygiene snippets: the pass must stay silent here."""
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def device_pure(x):
+    z = x / jnp.float32(2.0)
+    w = jnp.where(x > 0, z, -z)         # data-dependence via jnp.where
+    return jnp.zeros(x.shape, dtype=jnp.float32) + w
+
+
+@jax.jit
+def shape_branches(x, y):
+    # shape/dtype branches are static under trace — the sanctioned
+    # pattern (matcher/hmm.py trim_time_pad)
+    if x.shape[-1] == y.shape[-1] + 1:
+        x = x[..., :-1]
+    if x.dtype == jnp.float16:
+        x = x.astype(jnp.float32)
+    return x + y
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def static_branch(x, interpret=False):
+    if interpret:
+        return x
+    return x * 2
+
+
+def host_prep(x):
+    # NOT reachable from any jit entry: numpy is fine on the host side
+    arr = np.asarray(x)
+    if arr[0] > 0:
+        arr = arr + 1
+    return float(arr.sum())
+
+
+def entry_builder():
+    # jitting a named function by call-site also marks it (the pass
+    # resolves jax.jit(f) assignments); device_pure is already clean
+    return jax.jit(device_pure.__wrapped__)

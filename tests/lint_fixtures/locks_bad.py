@@ -1,0 +1,30 @@
+"""Known-BAD lock-discipline snippets: every marked line must fire."""
+import threading
+
+pending = {}
+_state_lock = threading.Lock()
+
+
+def enqueue(key, value):
+    with _state_lock:
+        pending[key] = value
+
+
+def drop_unlocked(key):
+    pending.pop(key, None)              # LD001: locked in enqueue, not here
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.total = 0                  # construction writes are fine
+        self.events = []
+
+    def add(self, n):
+        with self._lock:
+            self.total += n
+            self.events.append(n)
+
+    def reset_unlocked(self):
+        self.total = 0                  # LD001: written under lock in add
+        self.events.clear()             # LD001: written under lock in add

@@ -1,0 +1,43 @@
+"""Known-GOOD lock-discipline snippets: the pass must stay silent here."""
+import threading
+
+pending = {}
+_state_lock = threading.Lock()
+
+
+def enqueue(key, value):
+    with _state_lock:
+        pending[key] = value
+
+
+def drop(key):
+    with _state_lock:
+        pending.pop(key, None)          # every write under the lock
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.total = 0
+        self.events = []
+
+    def add(self, n):
+        with self._lock:
+            self.total += n
+            self.events.append(n)
+
+    def reset(self):
+        with self._lock:
+            self.total = 0
+            self.events.clear()
+
+
+class LockFree:
+    """No lock anywhere: a single-threaded or queue-mediated design is
+    not a LD001 violation (nothing established a locking convention)."""
+
+    def __init__(self):
+        self.seen = 0
+
+    def bump(self):
+        self.seen += 1

@@ -1,0 +1,291 @@
+"""reporter-lint suite tests: every pass fires on its known-bad fixture,
+stays silent on the matching known-good one, the ABI cross-check catches
+an injected mismatch against the LIVE pair, and a repo-wide run is clean
+against the committed baseline (no new findings, no stale entries).
+"""
+import os
+import re
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "lint_fixtures")
+sys.path.insert(0, REPO)
+
+from reporter_tpu import analysis                      # noqa: E402
+from reporter_tpu.analysis import abi, hotpath, jit_hygiene, locks  # noqa: E402
+from reporter_tpu.analysis.core import SourceFile, parse_suppressions  # noqa: E402
+
+LIVE_CPP = os.path.join(REPO, abi.DEFAULT_CPP)
+LIVE_PY = os.path.join(REPO, abi.DEFAULT_PY)
+
+
+def _fixture(name: str, relpath: str) -> SourceFile:
+    """Load a fixture under a fake repo-relative path so the passes'
+    module-scope filters apply."""
+    sf = SourceFile.load(os.path.join(FIXTURES, name), REPO)
+    sf.relpath = relpath
+    return sf
+
+
+def _run_pass(pass_mod, name: str, relpath: str):
+    sf = _fixture(name, relpath)
+    findings = analysis.filter_suppressed(pass_mod.run([sf], REPO), [sf])
+    return sf, findings
+
+
+def _expected_lines(sf: SourceFile, rule: str):
+    """Lines whose trailing comment names the rule (fixture convention:
+    ``# HP001: why`` / ``# JH001 (x2): why``)."""
+    out = {}
+    for i, line in enumerate(sf.text.splitlines(), start=1):
+        m = re.search(rf"#\s*{rule}(?:\s*\(x(\d+)\))?:", line)
+        if m:
+            out[i] = int(m.group(1) or 1)
+    return out
+
+
+def _assert_matches_annotations(sf, findings, rules):
+    got = {}
+    for f in findings:
+        got.setdefault(f.rule, {}).setdefault(f.line, 0)
+        got[f.rule][f.line] += 1
+    for rule in rules:
+        assert got.get(rule, {}) == _expected_lines(sf, rule), \
+            f"{rule} findings diverge from fixture annotations"
+
+
+# ---- hot-path purity -------------------------------------------------------
+
+def test_hotpath_fires_on_bad_fixture():
+    sf, findings = _run_pass(hotpath, "hotpath_bad.py",
+                             "reporter_tpu/matcher/fixture_bad.py")
+    _assert_matches_annotations(sf, findings, ("HP001", "HP002", "HP003"))
+
+
+def test_hotpath_silent_on_good_fixture():
+    _, findings = _run_pass(hotpath, "hotpath_good.py",
+                            "reporter_tpu/matcher/fixture_good.py")
+    assert findings == []
+
+
+def test_hotpath_scope_is_declared_module_set():
+    # the same bad code OUTSIDE the declared hot-path set is not flagged
+    _, findings = _run_pass(hotpath, "hotpath_bad.py",
+                            "reporter_tpu/tools/fixture_bad.py")
+    assert findings == []
+
+
+# ---- jit hygiene -----------------------------------------------------------
+
+def test_jit_fires_on_bad_fixture():
+    sf, findings = _run_pass(jit_hygiene, "jit_bad.py",
+                             "reporter_tpu/ops/fixture_bad.py")
+    _assert_matches_annotations(sf, findings, ("JH001", "JH002", "JH003"))
+
+
+def test_jit_silent_on_good_fixture():
+    _, findings = _run_pass(jit_hygiene, "jit_good.py",
+                            "reporter_tpu/ops/fixture_good.py")
+    assert findings == []
+
+
+def test_jit_reaches_called_helpers():
+    # the while-loop branch lives in helper(), reached only through the
+    # jitted entry_calls_helper — cross-function reachability must hold
+    sf, findings = _run_pass(jit_hygiene, "jit_bad.py",
+                             "reporter_tpu/ops/fixture_bad.py")
+    helper_line = next(i for i, ln in
+                       enumerate(sf.text.splitlines(), start=1)
+                       if "while v > 0" in ln)
+    assert any(f.rule == "JH003" and f.line == helper_line
+               for f in findings)
+
+
+# ---- lock discipline -------------------------------------------------------
+
+def test_locks_fire_on_bad_fixture():
+    sf, findings = _run_pass(locks, "locks_bad.py",
+                             "reporter_tpu/streaming/fixture_bad.py")
+    _assert_matches_annotations(sf, findings, ("LD001",))
+
+
+def test_locks_silent_on_good_fixture():
+    _, findings = _run_pass(locks, "locks_good.py",
+                            "reporter_tpu/streaming/fixture_good.py")
+    assert findings == []
+
+
+# ---- suppressions ----------------------------------------------------------
+
+def test_suppression_comment_silences_rule():
+    src = ("def f(rows):\n"
+           "    out = []\n"
+           "    for r in rows:\n"
+           "        out.append({'id': r})  # lint: ignore[HP002]\n"
+           "    return out\n")
+    import ast
+    sf = SourceFile(path="x", relpath="reporter_tpu/matcher/x.py",
+                    text=src, tree=ast.parse(src),
+                    suppressions=parse_suppressions(src))
+    findings = analysis.filter_suppressed(hotpath.run([sf], REPO), [sf])
+    assert findings == []
+    # without the suppression the same code fires
+    bare = src.replace("  # lint: ignore[HP002]", "")
+    sf2 = SourceFile(path="x", relpath="reporter_tpu/matcher/x.py",
+                     text=bare, tree=ast.parse(bare),
+                     suppressions=parse_suppressions(bare))
+    assert any(f.rule == "HP002" for f in hotpath.run([sf2], REPO))
+
+
+# ---- ABI cross-check -------------------------------------------------------
+
+def _read(path):
+    with open(path, encoding="utf-8") as f:
+        return f.read()
+
+
+def test_abi_good_fixture_pair_is_clean():
+    findings = abi.check(_read(os.path.join(FIXTURES, "abi_good.cpp")),
+                         _read(os.path.join(FIXTURES, "abi_good.py")),
+                         "abi_good.cpp", "abi_good.py")
+    assert findings == []
+
+
+def test_abi_bad_fixture_catches_every_drift_class():
+    findings = abi.check(_read(os.path.join(FIXTURES, "abi_good.cpp")),
+                         _read(os.path.join(FIXTURES, "abi_bad.py")),
+                         "abi_good.cpp", "abi_bad.py")
+    rules = {f.rule for f in findings}
+    assert rules == {"ABI001", "ABI002", "ABI003", "ABI004", "ABI005"}
+
+
+def test_abi_live_pair_validates_at_version_10():
+    cpp = _read(LIVE_CPP)
+    exports, version = abi.parse_cpp(cpp)
+    assert version == 10
+    assert "rt_prepare_batch" in exports and "rt_assemble_batch" in exports
+    findings = abi.check(cpp, _read(LIVE_PY))
+    assert findings == [], [f.render() for f in findings]
+
+
+def test_abi_injected_argtypes_mismatch_is_caught(tmp_path):
+    """Satellite contract: inject a deliberate argtypes mismatch into a
+    fixture COPY of the live binding and assert the checker fails it."""
+    live = _read(LIVE_PY)
+    # rt_route_matrices binds T as c_int64; narrow it to c_int32
+    target = ("lib.rt_route_matrices.argtypes = [\n"
+              "            ctypes.c_void_p, ctypes.c_int64,")
+    assert target in live, "live binding drifted; update the injection"
+    mutated = live.replace(
+        target, target.replace("c_int64", "c_int32"), 1)
+    bad_py = tmp_path / "native_init_mutated.py"
+    bad_py.write_text(mutated, encoding="utf-8")
+    findings = abi.run_paths(LIVE_CPP, str(bad_py),
+                             abi.DEFAULT_CPP, "native_init_mutated.py")
+    assert any(f.rule == "ABI003" and "rt_route_matrices" in f.message
+               and "i32" in f.message for f in findings), \
+        [f.render() for f in findings]
+
+
+def test_abi_version_bump_is_caught(tmp_path):
+    live = _read(LIVE_PY)
+    mutated = re.sub(r"^ABI_VERSION = \d+", "ABI_VERSION = 999", live,
+                     count=1, flags=re.MULTILINE)
+    assert mutated != live
+    bad_py = tmp_path / "native_init_ver.py"
+    bad_py.write_text(mutated, encoding="utf-8")
+    findings = abi.run_paths(LIVE_CPP, str(bad_py),
+                             abi.DEFAULT_CPP, "native_init_ver.py")
+    assert any(f.rule == "ABI004" for f in findings)
+
+
+# ---- the driver ------------------------------------------------------------
+
+def _lint(*args):
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "lint.py"), *args],
+        capture_output=True, text=True, cwd=REPO)
+
+
+def test_repo_wide_run_is_clean_against_committed_baseline():
+    """Acceptance gate: `python tools/lint.py` exits 0 — no new findings,
+    no stale baseline entries."""
+    proc = _lint()
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_abi_only_guard_passes_on_live_pair_and_fails_on_mismatch(tmp_path):
+    assert _lint("--abi-only").returncode == 0
+    mutated = _read(LIVE_PY).replace("ctypes.c_double, c_f32p]",
+                                     "ctypes.c_double, c_f64p]", 1)
+    bad_py = tmp_path / "native_guard.py"
+    bad_py.write_text(mutated, encoding="utf-8")
+    proc = _lint("--abi-only", "--abi-py", str(bad_py))
+    assert proc.returncode == 1
+    assert "ABI003" in proc.stdout
+
+
+def test_stale_baseline_entry_fails_the_run(tmp_path):
+    stale = tmp_path / "baseline.txt"
+    stale.write_text("reporter_tpu/matcher/matcher.py:1: HP001 ghost\n",
+                     encoding="utf-8")
+    proc = _lint("--baseline", str(stale))
+    assert proc.returncode == 1
+    assert "stale baseline entry" in proc.stdout
+
+
+def test_partial_run_does_not_report_unrelated_baseline_as_stale(tmp_path):
+    # an entry for a file OUTSIDE the requested paths legitimately does
+    # not fire on a partial run — it must not be called stale
+    base = tmp_path / "baseline.txt"
+    base.write_text("reporter_tpu/service/report.py:1: HP001 ghost\n",
+                    encoding="utf-8")
+    proc = _lint("reporter_tpu/matcher/matcher.py",
+                 "--baseline", str(base))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    # and --write-baseline refuses a partial run outright
+    proc = _lint("reporter_tpu/matcher/matcher.py", "--write-baseline",
+                 "--baseline", str(base))
+    assert proc.returncode == 2
+
+
+def test_jit_positional_dtype_not_flagged():
+    import ast
+    src = ("import jax\nimport jax.numpy as jnp\n"
+           "@jax.jit\n"
+           "def f(x):\n"
+           "    a = jnp.arange(0, 10, 1, jnp.int32)\n"
+           "    b = jnp.zeros(x.shape, jnp.float32)\n"
+           "    c = jnp.arange(10)\n"                      # no dtype: flag
+           "    return a + b + c\n")
+    sf = SourceFile(path="x", relpath="reporter_tpu/ops/x.py", text=src,
+                    tree=ast.parse(src), suppressions={})
+    findings = jit_hygiene.run([sf], REPO)
+    assert [f.line for f in findings if f.rule == "JH002"] == [7]
+
+
+def test_abi_parses_plain_int_and_typed_pointer_returns():
+    cpp = ('extern "C" {\n'
+           "int32_t rt_abi_version(void) { return 1; }\n"
+           "int rt_plain(int64_t n) { return 0; }\n"
+           "double* rt_buf(void* h) { return 0; }\n"
+           "}\n")
+    exports, version = abi.parse_cpp(cpp)
+    assert version == 1
+    assert exports["rt_plain"] == (("val", "i32"), [("val", "i64")])
+    assert exports["rt_buf"] == (("ptr", "f64"), [("ptr", "void")])
+    # an unbound export of either shape raises ABI001, not silence
+    py = "ABI_VERSION = 1\n"
+    rules = {f.rule for f in abi.check(cpp, py, "c.cpp", "b.py")}
+    assert "ABI001" in rules
+
+
+def test_list_rules_covers_all_four_passes():
+    proc = _lint("--list-rules")
+    assert proc.returncode == 0
+    for rule in ("HP001", "HP002", "HP003", "JH001", "JH002", "JH003",
+                 "ABI001", "ABI004", "LD001"):
+        assert rule in proc.stdout

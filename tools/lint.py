@@ -1,0 +1,133 @@
+#!/usr/bin/env python
+"""reporter-lint driver: run the project-native static-analysis suite.
+
+Usage:
+  python tools/lint.py                 # full suite over reporter_tpu/
+  python tools/lint.py --abi-only     # just the ctypes<->C++ ABI guard
+  python tools/lint.py --list-rules   # rule catalogue
+  python tools/lint.py path.py ...    # restrict the code passes to paths
+
+Exit status: 0 clean; 1 findings (or stale baseline entries); 2 usage /
+internal error. Output lines are ``file:line: RULE-ID message``.
+
+Baseline workflow: findings listed verbatim in ``tools/lint_baseline.txt``
+are accepted (grandfathered) — but an entry that stops firing fails the
+run as *stale* so the file can only shrink honestly. ``--write-baseline``
+regenerates it from the current findings. ``--abi-only`` ignores the
+baseline entirely: an ABI mismatch is never acceptable debt.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+from reporter_tpu import analysis  # noqa: E402
+from reporter_tpu.analysis import abi  # noqa: E402
+
+DEFAULT_BASELINE = os.path.join(REPO_ROOT, "tools", "lint_baseline.txt")
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="reporter-lint", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    parser.add_argument("paths", nargs="*",
+                        help="files/dirs for the code passes "
+                             "(default: reporter_tpu/)")
+    parser.add_argument("--abi-only", action="store_true",
+                        help="run only the ABI cross-check (pre-commit "
+                             "guard; ignores the baseline)")
+    parser.add_argument("--abi-cpp", default=None,
+                        help="override the C++ runtime source path")
+    parser.add_argument("--abi-py", default=None,
+                        help="override the ctypes binding path")
+    parser.add_argument("--baseline", default=DEFAULT_BASELINE,
+                        help="baseline file (default tools/lint_baseline.txt)")
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="ignore the baseline (report everything)")
+    parser.add_argument("--write-baseline", action="store_true",
+                        help="rewrite the baseline from current findings")
+    parser.add_argument("--list-rules", action="store_true")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule in sorted(analysis.ALL_RULES):
+            print(f"{rule}  {analysis.ALL_RULES[rule]}")
+        return 0
+
+    cpp_path = args.abi_cpp or os.path.join(REPO_ROOT, abi.DEFAULT_CPP)
+    py_path = args.abi_py or os.path.join(REPO_ROOT, abi.DEFAULT_PY)
+
+    def abi_findings():
+        if not (os.path.exists(cpp_path) and os.path.exists(py_path)):
+            print(f"error: ABI pair missing ({cpp_path}, {py_path})",
+                  file=sys.stderr)
+            raise SystemExit(2)
+        return abi.run_paths(
+            cpp_path, py_path,
+            os.path.relpath(cpp_path, REPO_ROOT).replace(os.sep, "/"),
+            os.path.relpath(py_path, REPO_ROOT).replace(os.sep, "/"))
+
+    if args.abi_only:
+        findings = abi_findings()
+        for f in findings:
+            print(f.render())
+        if findings:
+            print(f"reporter-lint --abi-only: {len(findings)} ABI "
+                  "mismatch(es)", file=sys.stderr)
+            return 1
+        print("reporter-lint --abi-only: binding matches the C++ runtime")
+        return 0
+
+    roots = [os.path.abspath(p) for p in args.paths] or None
+    files = analysis.collect_py_files(REPO_ROOT, roots)
+    findings = analysis.run_code_passes(files, REPO_ROOT)
+    # the ABI pair is fixed infrastructure, checked on every full run
+    if roots is None:
+        findings = sorted(findings + abi_findings())
+
+    if args.write_baseline and roots is not None:
+        # a partial run sees a subset of findings; writing it out would
+        # silently drop every grandfathered entry outside the paths
+        print("error: --write-baseline requires a full run (no paths)",
+              file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        with open(args.baseline, "w", encoding="utf-8") as f:
+            f.write("# reporter-lint baseline: grandfathered findings.\n"
+                    "# Entries must match current findings exactly; stale\n"
+                    "# lines fail the lint run. Prefer fixing over listing.\n")
+            for fnd in findings:
+                f.write(fnd.render() + "\n")
+        print(f"wrote {len(findings)} finding(s) to {args.baseline}")
+        return 0
+
+    baseline = [] if args.no_baseline \
+        else analysis.load_baseline(args.baseline)
+    new, stale = analysis.compare_baseline(findings, baseline)
+    if roots is not None:
+        # a partial run cannot judge staleness: entries for files outside
+        # the requested paths legitimately did not fire this run
+        stale = []
+    for f in new:
+        print(f.render())
+    for entry in stale:
+        print(f"stale baseline entry (no longer fires — remove it): "
+              f"{entry}")
+    if new or stale:
+        print(f"reporter-lint: {len(new)} finding(s), {len(stale)} stale "
+              f"baseline entr(y/ies)", file=sys.stderr)
+        return 1
+    n_base = f" ({len(baseline)} baselined)" if baseline else ""
+    print(f"reporter-lint: clean — {len(files)} files, "
+          f"{len(analysis.ALL_RULES)} rules{n_base}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

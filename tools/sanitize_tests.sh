@@ -1,0 +1,62 @@
+#!/bin/sh
+# CI `sanitize` stage: build the native host runtime under asan and ubsan
+# and run the native test files against the instrumented libraries.
+#
+# The Python interpreter itself stays uninstrumented — the asan runtime is
+# LD_PRELOADed so the instrumented .so can resolve its symbols, and leak
+# checking is off (CPython "leaks" by design at exit; we are after
+# overflows/UB in host_runtime.cpp, which the prep/assemble tests drive
+# hard). Skips cleanly (exit 0 with a notice) when the toolchain lacks
+# sanitizer support, per the CI contract.
+set -u
+
+ROOT="$(cd "$(dirname "$0")/.." && pwd)"
+NATIVE="$ROOT/reporter_tpu/native"
+CXX="${CXX:-g++}"
+TESTS="tests/test_native.py tests/test_native_batch.py"
+
+probe() {
+    # can this compiler link the sanitizer runtime at all?
+    echo 'int main(){return 0;}' | "$CXX" "-fsanitize=$1" -x c++ - \
+        -o /tmp/_reporter_san_probe 2>/dev/null
+}
+
+cd "$ROOT" || exit 2
+rc=0
+ran=0
+
+if probe address; then
+    ran=1
+    echo "== sanitize: building + testing under AddressSanitizer =="
+    make -C "$NATIVE" asan || exit 1
+    libasan="$("$CXX" -print-file-name=libasan.so)"
+    # libstdc++ rides along in LD_PRELOAD: asan's __cxa_throw interceptor
+    # must resolve the real symbol at init, before jaxlib's dlopen'd C++
+    # extensions throw (otherwise: "real___cxa_throw != 0" CHECK abort)
+    libstdcxx="$("$CXX" -print-file-name=libstdc++.so)"
+    LD_PRELOAD="$libasan $libstdcxx" \
+    ASAN_OPTIONS="detect_leaks=0:abort_on_error=1" \
+    REPORTER_TPU_NATIVE_LIB="$NATIVE/libreporter_host_asan.so" \
+    JAX_PLATFORMS=cpu \
+        python -m pytest $TESTS -q -p no:cacheprovider || rc=1
+else
+    echo "== sanitize: $CXX lacks -fsanitize=address; skipping asan =="
+fi
+
+if probe undefined; then
+    ran=1
+    echo "== sanitize: building + testing under UBSan =="
+    make -C "$NATIVE" ubsan || exit 1
+    UBSAN_OPTIONS="print_stacktrace=1:halt_on_error=1" \
+    REPORTER_TPU_NATIVE_LIB="$NATIVE/libreporter_host_ubsan.so" \
+    JAX_PLATFORMS=cpu \
+        python -m pytest $TESTS -q -p no:cacheprovider || rc=1
+else
+    echo "== sanitize: $CXX lacks -fsanitize=undefined; skipping ubsan =="
+fi
+
+if [ "$ran" = 0 ]; then
+    echo "== sanitize: no sanitizer support in this toolchain; skipped =="
+    exit 0
+fi
+exit $rc
