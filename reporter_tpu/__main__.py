@@ -13,6 +13,8 @@ PrintConsumer):
   tiles            list/download graph tiles for a bbox     [get_tiles et al]
   graph            build/tile/inspect road networks   [valhalla build tools]
   synth            synthetic GPS trace generator      [generate_test_trace]
+  datastore        histogram datastore: ingest/compact/query/stats
+                   over flushed tiles                 [datastore service]
 """
 from __future__ import annotations
 
@@ -79,6 +81,12 @@ def _graph():
 @_cmd("accuracy")
 def _accuracy():
     from .tools.accuracy_cli import main
+    return main
+
+
+@_cmd("datastore")
+def _datastore():
+    from .tools.datastore_cli import main
     return main
 
 

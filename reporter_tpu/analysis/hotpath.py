@@ -41,8 +41,10 @@ RULES = {
 }
 
 #: the declared hot-path module set (ISSUE 2): matcher, graph, the
-#: columnar batch core, the streaming batcher, and the serving-side
-#: report/dispatch path that runs once per trace per request.
+#: columnar batch core, the streaming batcher, the serving-side
+#: report/dispatch path that runs once per trace per request, and the
+#: datastore's ingest/aggregate kernels (ISSUE 3) — the serving-side
+#: analogue of the matcher's batch pipeline, held to the same purity.
 HOT_PATH_PREFIXES = (
     "reporter_tpu/matcher/",
     "reporter_tpu/graph/",
@@ -50,6 +52,8 @@ HOT_PATH_PREFIXES = (
     "reporter_tpu/streaming/batcher.py",
     "reporter_tpu/service/report.py",
     "reporter_tpu/service/dispatch.py",
+    "reporter_tpu/datastore/ingest.py",
+    "reporter_tpu/datastore/aggregate.py",
 )
 
 #: "relpath::qualname" -> why per-element Python is the contract there.
@@ -82,6 +86,10 @@ EDGE_FUNCTIONS: Dict[str, str] = {
         "numpy fallback assembler + JSON edge (native path bypasses it)",
     "reporter_tpu/matcher/assemble.py::_chain_to_segments":
         "numpy fallback assembler + JSON edge (native path bypasses it)",
+    # tile CSV wire ingestion: the one sanctioned per-line pass turning a
+    # flushed tile payload into columns (everything downstream is numpy)
+    "reporter_tpu/datastore/ingest.py::parse_tile_csv":
+        "tile-CSV columnarisation edge (one pass per flushed tile)",
     # graph build/load edges: run at startup or in tooling, not per batch
     "reporter_tpu/graph/osm.py::network_from_osm_xml":
         "OSM import edge (offline graph build)",

@@ -1,0 +1,64 @@
+"""The datastore: the consumer of flushed traffic tiles.
+
+The reporter half (matcher + streaming worker) emits anonymised,
+time-quantised segment tiles; this subsystem closes the loop the way the
+reference ecosystem's companion datastore service did — turning tiles
+into per-segment speed histograms and answering queries:
+
+- :mod:`schema`     — histogram axes, composite keys, columnar batch
+- :mod:`ingest`     — tile CSV / in-process ``Segment`` ingestion
+- :mod:`aggregate`  — whole-batch searchsorted/add.at histogram kernel
+- :mod:`store`      — append-only columnar partitions, atomic commits,
+  mmap reads, compaction
+- :mod:`query`      — mean / percentiles / coverage / transitions
+
+:class:`LocalDatastore` is the one-stop facade the service's
+``/histogram`` action, ``datastore_cli``, and the streaming worker's tee
+all share.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from .aggregate import Delta, aggregate, merge_deltas
+from .ingest import ingest_dir, ingest_file, parse_tile_csv, scan_tiles
+from .query import (
+    DEFAULT_PERCENTILES,
+    hours_for_range,
+    parse_hours_spec,
+    query_segment,
+)
+from .schema import ObservationBatch
+from .store import HistogramStore
+
+
+class LocalDatastore(HistogramStore):
+    """A histogram store plus its query surface, rooted at a directory."""
+
+    def ingest_segments(self, segments) -> int:
+        """Zero-serialisation path: aggregate culled ``Segment`` structs
+        straight out of the anonymiser's flush, no CSV round trip."""
+        return self.ingest(ObservationBatch.from_segments(segments))
+
+    def ingest_csv(self, payload: str) -> int:
+        return self.ingest(parse_tile_csv(payload))
+
+    def ingest_dir(self, root: str, delete: bool = False,
+                   limit: Optional[int] = None) -> dict:
+        return ingest_dir(self, root, delete=delete, limit=limit)
+
+    def query(self, segment_id: int,
+              hours: Optional[Sequence[int]] = None,
+              percentiles: Sequence[float] = DEFAULT_PERCENTILES,
+              max_transitions: int = 32) -> dict:
+        return query_segment(self, segment_id, hours=hours,
+                             percentiles=percentiles,
+                             max_transitions=max_transitions)
+
+
+__all__ = [
+    "Delta", "HistogramStore", "LocalDatastore", "ObservationBatch",
+    "aggregate", "merge_deltas", "parse_tile_csv", "scan_tiles",
+    "ingest_file", "ingest_dir", "query_segment", "hours_for_range",
+    "parse_hours_spec", "DEFAULT_PERCENTILES",
+]

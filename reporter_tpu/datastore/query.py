@@ -1,0 +1,162 @@
+"""Query surface over the histogram store.
+
+A segment query binary-searches each live segment file of the owning
+partition for the segment's contiguous composite-key range (the keys are
+sorted — schema.py), scatters the slices into a dense
+``(168, N_SPEED_BINS)`` grid, and answers:
+
+- observation count + exact mean speed (from the stored speed sums),
+- interpolated percentiles from the binned CDF,
+- the speed histogram itself (per requested hour set),
+- hour-of-week coverage (distinct hours with data / hours asked),
+- next-segment transition counts.
+
+``hours`` restricts to a subset of the week (e.g. the morning peak);
+:func:`hours_for_range` converts an epoch time range into that subset.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..core.osmlr import tile_index, tile_level
+from ..utils import metrics
+from .schema import (
+    CELLS_PER_SEGMENT,
+    HOURS_PER_WEEK,
+    N_SPEED_BINS,
+    SPEED_BIN_KPH,
+    hour_of_week,
+    segment_key_range,
+)
+
+DEFAULT_PERCENTILES = (25.0, 50.0, 75.0, 95.0)
+
+
+def hours_for_range(t0: int, t1: int) -> np.ndarray:
+    """Hour-of-week subset covered by an epoch range [t0, t1)."""
+    if t1 <= t0:
+        return np.zeros(0, dtype=np.int64)
+    n_hours = min((int(t1) - 1) // 3600 - int(t0) // 3600 + 1,
+                  HOURS_PER_WEEK)
+    first = hour_of_week(np.asarray([int(t0)]))[0]
+    return np.unique((first + np.arange(n_hours)) % HOURS_PER_WEEK)
+
+
+def parse_hours_spec(spec: Optional[str]):
+    """Parse an hours argument: ``'7-9'`` (inclusive range) or ``'7,8,9'``.
+    Shared by the CLI and the /histogram GET surface; range bounds are
+    validated here, membership in [0, 167] by :func:`query_segment`."""
+    if spec is None:
+        return None
+    if "-" in spec:
+        lo, hi = spec.split("-", 1)
+        lo, hi = int(lo), int(hi)
+        if hi < lo:
+            raise ValueError(f"empty hours range {spec!r}")
+        return list(range(lo, hi + 1))
+    return [int(h) for h in spec.split(",") if h]
+
+
+def _percentiles(counts: np.ndarray, qs: Sequence[float]) -> dict:
+    """Interpolated percentiles from per-bin counts (kph)."""
+    for q in qs:
+        if not 0.0 < float(q) <= 100.0:
+            raise ValueError(f"percentile {q} out of range (0, 100]")
+    total = counts.sum()
+    out = {}
+    if total == 0:
+        for q in qs:
+            out[f"p{q:g}"] = None
+        return out
+    cdf = np.cumsum(counts)
+    lower = np.arange(N_SPEED_BINS) * SPEED_BIN_KPH
+    for q in qs:
+        target = total * (float(q) / 100.0)
+        b = int(np.searchsorted(cdf, target, side="left"))
+        b = min(b, N_SPEED_BINS - 1)
+        prev = cdf[b - 1] if b else 0
+        frac = (target - prev) / max(counts[b], 1)
+        out[f"p{q:g}"] = round(float(lower[b] + frac * SPEED_BIN_KPH), 3)
+    return out
+
+
+def query_segment(store, segment_id: int,
+                  hours: Optional[Sequence[int]] = None,
+                  percentiles: Sequence[float] = DEFAULT_PERCENTILES,
+                  max_transitions: int = 32) -> dict:
+    """Answer one segment's histogram query; see module docstring."""
+    with metrics.timer("datastore.query"):
+        segment_id = int(segment_id)
+        level = tile_level(segment_id)
+        index = tile_index(segment_id)
+        lo, hi = segment_key_range(segment_id)
+        grid_count = np.zeros(CELLS_PER_SEGMENT, dtype=np.int64)
+        grid_speed = np.zeros(CELLS_PER_SEGMENT, dtype=np.float64)
+        trans_to_parts = []
+        trans_count_parts = []
+        for part in store.live_segments(level, index):
+            i0 = int(np.searchsorted(part.hist_key, lo, side="left"))
+            i1 = int(np.searchsorted(part.hist_key, hi, side="left"))
+            if i1 > i0:
+                cell = np.asarray(part.hist_key[i0:i1]) - lo
+                np.add.at(grid_count, cell, part.hist_count[i0:i1])
+                np.add.at(grid_speed, cell, part.hist_speed_sum[i0:i1])
+            j0 = int(np.searchsorted(part.trans_from, segment_id, "left"))
+            j1 = int(np.searchsorted(part.trans_from, segment_id, "right"))
+            if j1 > j0:
+                trans_to_parts.append(np.asarray(part.trans_to[j0:j1]))
+                trans_count_parts.append(np.asarray(part.trans_count[j0:j1]))
+
+        grid_count = grid_count.reshape(HOURS_PER_WEEK, N_SPEED_BINS)
+        grid_speed = grid_speed.reshape(HOURS_PER_WEEK, N_SPEED_BINS)
+        if hours is not None:
+            hour_sel = np.unique(np.asarray(list(hours), dtype=np.int64))
+            if hour_sel.size and (hour_sel.min() < 0
+                                  or hour_sel.max() >= HOURS_PER_WEEK):
+                raise ValueError("hours must be in [0, 167]")
+        else:
+            hour_sel = np.arange(HOURS_PER_WEEK)
+        sel_count = grid_count[hour_sel]
+        sel_speed = grid_speed[hour_sel]
+
+        bin_counts = sel_count.sum(axis=0)
+        total = int(bin_counts.sum())
+        mean = round(float(sel_speed.sum() / total), 3) if total else None
+        hours_covered = int((sel_count.sum(axis=1) > 0).sum())
+
+        if trans_to_parts:
+            to_all = np.concatenate(trans_to_parts)
+            cnt_all = np.concatenate(trans_count_parts)
+            uto, inv = np.unique(to_all, return_inverse=True)
+            csum = np.zeros(uto.shape[0], dtype=np.int64)
+            np.add.at(csum, inv, cnt_all)
+            order = np.argsort(-csum, kind="stable")[:max_transitions]
+            transitions = [
+                {"next_id": int(uto[k]), "count": int(csum[k])}
+                for k in order]
+        else:
+            transitions = []
+
+        return {
+            "segment_id": segment_id,
+            "level": level,
+            "tile_index": index,
+            "count": total,
+            "mean_kph": mean,
+            "percentiles": _percentiles(bin_counts, percentiles),
+            "histogram": {
+                "bin_kph": SPEED_BIN_KPH,
+                "counts": bin_counts.tolist(),
+            },
+            "hours_queried": int(hour_sel.size),
+            "hours_covered": hours_covered,
+            "coverage": round(hours_covered / hour_sel.size, 4)
+            if hour_sel.size else 0.0,
+            "transitions": transitions,
+        }
+
+
+__all__ = ["query_segment", "hours_for_range", "parse_hours_spec",
+           "DEFAULT_PERCENTILES"]

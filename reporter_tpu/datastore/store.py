@@ -1,0 +1,225 @@
+"""Append-only columnar histogram store, partitioned by graph tile.
+
+Layout on disk (one partition per ``(level, tile_index)`` — the same key
+the anonymiser's flush paths and the OSMLR id low bits use)::
+
+    <root>/<level>/<tile_index>/
+        MANIFEST.json            {"seq": N, "segments": ["base-…", "delta-…"]}
+        delta-000001/            one committed aggregation increment
+            hist_key.npy         sorted int64 composite keys (schema.py)
+            hist_count.npy       int64
+            hist_speed_sum.npy   float64
+            trans_from.npy       int64 (sorted pairs)
+            trans_to.npy         int64
+            trans_count.npy      int64
+            meta.json
+        base-000007/             compaction output (same columns)
+
+Commit protocol (single-writer per process, lock-held; crash-safe):
+arrays are written into a dot-prefixed temp dir in the partition, then
+``os.replace``'d to the final segment name, then the manifest is
+rewritten via temp-file + ``os.replace``. A reader loads the manifest
+and mmaps only segments it lists, so a half-written segment is never
+visible and a crashed commit leaves only an ignorable temp dir.
+
+Reads are ``np.load(..., mmap_mode="r")`` — a query touches the pages of
+one binary-searched key range per segment file, not the whole partition.
+Compaction merges every live segment into a single ``base-`` segment and
+then deletes the merged dirs; concurrent readers holding the old
+manifest keep valid mmaps (POSIX unlink semantics).
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import shutil
+import threading
+import time
+from typing import Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from ..utils import metrics
+from .aggregate import Delta, aggregate, merge_deltas
+from .schema import ObservationBatch
+
+logger = logging.getLogger("reporter_tpu.datastore")
+
+MANIFEST = "MANIFEST.json"
+
+_COLUMNS = (
+    ("hist_key", np.int64),
+    ("hist_count", np.int64),
+    ("hist_speed_sum", np.float64),
+    ("trans_from", np.int64),
+    ("trans_to", np.int64),
+    ("trans_count", np.int64),
+)
+
+
+class HistogramStore:
+    """The local datastore: ingest observation batches, serve mmap'd
+    deltas to the query layer, compact partitions in place."""
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+        self._lock = threading.Lock()
+
+    # -- paths -------------------------------------------------------------
+    def partition_dir(self, level: int, index: int) -> str:
+        return os.path.join(self.root, str(int(level)), str(int(index)))
+
+    def partitions(self) -> Iterator[Tuple[int, int]]:
+        """All (level, tile_index) partitions present on disk."""
+        try:
+            levels = sorted(d for d in os.listdir(self.root)
+                            if d.isdigit())
+        except FileNotFoundError:
+            return
+        for lvl in levels:
+            ldir = os.path.join(self.root, lvl)
+            for idx in sorted(d for d in os.listdir(ldir) if d.isdigit()):
+                if os.path.exists(os.path.join(ldir, idx, MANIFEST)):
+                    yield int(lvl), int(idx)
+
+    # -- manifest ----------------------------------------------------------
+    def _read_manifest(self, pdir: str) -> dict:
+        try:
+            with open(os.path.join(pdir, MANIFEST), encoding="utf-8") as f:
+                return json.load(f)
+        except FileNotFoundError:
+            return {"seq": 0, "segments": []}
+
+    def _write_manifest(self, pdir: str, manifest: dict) -> None:
+        tmp = os.path.join(pdir, ".MANIFEST.tmp")
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(manifest, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, os.path.join(pdir, MANIFEST))
+
+    # -- write path --------------------------------------------------------
+    def append(self, level: int, index: int, delta: Delta) -> str:
+        """Commit one delta as a new immutable segment; returns its name."""
+        with self._lock, metrics.timer("datastore.store.append"):
+            pdir = self.partition_dir(level, index)
+            os.makedirs(pdir, exist_ok=True)
+            manifest = self._read_manifest(pdir)
+            seq = manifest["seq"] + 1
+            name = f"delta-{seq:06d}"
+            self._write_segment(pdir, name, delta)
+            manifest["seq"] = seq
+            manifest["segments"] = manifest["segments"] + [name]
+            self._write_manifest(pdir, manifest)
+            return name
+
+    def _write_segment(self, pdir: str, name: str, delta: Delta) -> None:
+        tmp = os.path.join(pdir, f".tmp-{name}-{os.getpid()}")
+        os.makedirs(tmp)
+        for col, dtype in _COLUMNS:
+            np.save(os.path.join(tmp, col + ".npy"),
+                    np.ascontiguousarray(getattr(delta, col), dtype=dtype))
+        with open(os.path.join(tmp, "meta.json"), "w", encoding="utf-8") as f:
+            json.dump({"cells": len(delta), "rows": delta.rows,
+                       "transitions": int(delta.trans_from.shape[0]),
+                       "created": time.time()}, f)
+        os.replace(tmp, os.path.join(pdir, name))
+
+    def ingest(self, obs: ObservationBatch) -> int:
+        """Aggregate + append a whole observation batch (possibly spanning
+        partitions). Returns the number of valid rows ingested."""
+        rows = 0
+        for (level, index), delta in aggregate(obs).items():
+            self.append(level, index, delta)
+            rows += delta.rows
+        return rows
+
+    # -- read path ---------------------------------------------------------
+    def load_segment(self, pdir: str, name: str) -> Optional[Delta]:
+        """mmap one committed segment's columns; None if it was compacted
+        away between manifest read and open."""
+        sdir = os.path.join(pdir, name)
+        try:
+            cols = {col: np.load(os.path.join(sdir, col + ".npy"),
+                                 mmap_mode="r")
+                    for col, _ in _COLUMNS}
+        except FileNotFoundError:
+            return None
+        return Delta(**cols)
+
+    def live_segments(self, level: int, index: int) -> List[Delta]:
+        """Every committed delta of one partition, mmap'd (may be empty)."""
+        pdir = self.partition_dir(level, index)
+        manifest = self._read_manifest(pdir)
+        out = []
+        for name in manifest["segments"]:
+            d = self.load_segment(pdir, name)
+            if d is not None:
+                out.append(d)
+        return out
+
+    # -- compaction --------------------------------------------------------
+    def compact(self, level: Optional[int] = None,
+                index: Optional[int] = None) -> dict:
+        """Merge each selected partition's segments into one ``base-``
+        segment. Returns ``{"partitions", "merged_segments"}``."""
+        merged = parts = 0
+        with metrics.timer("datastore.store.compact"):
+            for lvl, idx in list(self.partitions()):
+                if level is not None and lvl != level:
+                    continue
+                if index is not None and idx != index:
+                    continue
+                merged += self._compact_partition(lvl, idx)
+                parts += 1
+        return {"partitions": parts, "merged_segments": merged}
+
+    def _compact_partition(self, level: int, index: int) -> int:
+        with self._lock:
+            pdir = self.partition_dir(level, index)
+            manifest = self._read_manifest(pdir)
+            names = manifest["segments"]
+            if len(names) <= 1:
+                return 0
+            deltas = [d for d in (self.load_segment(pdir, n) for n in names)
+                      if d is not None]
+            seq = manifest["seq"] + 1
+            base = f"base-{seq:06d}"
+            self._write_segment(pdir, base, merge_deltas(deltas))
+            self._write_manifest(pdir, {"seq": seq, "segments": [base]})
+            # the new manifest is durable; merged segment dirs are dead
+            for name in names:
+                shutil.rmtree(os.path.join(pdir, name), ignore_errors=True)
+            logger.info("compacted %d/%d: %d segments -> %s",
+                        level, index, len(names), base)
+            return len(names)
+
+    # -- introspection -----------------------------------------------------
+    def stats(self) -> dict:
+        """Partition/segment/cell totals plus on-disk byte size."""
+        out: Dict[str, int] = {"partitions": 0, "segments": 0, "cells": 0,
+                               "rows": 0, "transitions": 0, "bytes": 0}
+        for level, index in self.partitions():
+            out["partitions"] += 1
+            pdir = self.partition_dir(level, index)
+            for name in self._read_manifest(pdir)["segments"]:
+                sdir = os.path.join(pdir, name)
+                try:
+                    with open(os.path.join(sdir, "meta.json"),
+                              encoding="utf-8") as f:
+                        meta = json.load(f)
+                except (FileNotFoundError, ValueError):
+                    continue
+                out["segments"] += 1
+                out["cells"] += meta.get("cells", 0)
+                out["rows"] += meta.get("rows", 0)
+                out["transitions"] += meta.get("transitions", 0)
+                out["bytes"] += sum(
+                    os.path.getsize(os.path.join(sdir, f))
+                    for f in os.listdir(sdir))
+        return out
+
+
+__all__ = ["HistogramStore", "MANIFEST"]

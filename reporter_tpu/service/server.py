@@ -36,8 +36,10 @@ from .dispatch import BatchDispatcher
 from .report import report
 
 # /report is the reference's only action (reporter_service.py:26);
-# /stats is new — a metrics snapshot (counters + stage timers)
-ACTIONS = {"report", "stats"}
+# /stats is new — a metrics snapshot (counters + stage timers);
+# /histogram is the datastore query surface (datastore/query.py), live
+# when the service was built with a datastore attached
+ACTIONS = {"report", "stats", "histogram"}
 
 
 class ReporterService:
@@ -46,8 +48,11 @@ class ReporterService:
     def __init__(self, matcher: SegmentMatcher,
                  threshold_sec: int | None = None,
                  max_batch: int | None = None,
-                 max_wait_ms: float | None = None):
+                 max_wait_ms: float | None = None,
+                 datastore=None):
         self.matcher = matcher
+        # optional LocalDatastore serving /histogram (None = 503 there)
+        self.datastore = datastore
         self.threshold_sec = threshold_sec if threshold_sec is not None else \
             int(os.environ.get("THRESHOLD_SEC", 15))
         self.dispatcher = BatchDispatcher(
@@ -88,6 +93,35 @@ class ReporterService:
             return 200, json.dumps(data, separators=(",", ":"))
         except Exception as e:
             return 500, json.dumps({"error": str(e)})
+
+    def histogram(self, params: dict) -> tuple[int, str]:
+        """Answer a /histogram query; (status, body). ``params`` carries
+        ``segment_id`` (required) plus optional ``hours`` (list of
+        hour-of-week ints), ``time_range`` ([t0, t1) epoch seconds,
+        converted to the hour set it covers), and ``percentiles``."""
+        if self.datastore is None:
+            return 503, ('{"error":"no datastore attached; serve with a '
+                         '--datastore directory"}')
+        from ..datastore import DEFAULT_PERCENTILES, hours_for_range
+        seg = params.get("segment_id")
+        if seg is None:
+            return 400, '{"error":"segment_id is required"}'
+        hours = params.get("hours")
+        if hours is None and params.get("time_range") is not None:
+            try:
+                t0, t1 = params["time_range"]
+            except Exception:
+                return 400, ('{"error":"time_range must be a [start, end) '
+                             'epoch-seconds pair"}')
+            hours = hours_for_range(int(t0), int(t1)).tolist()
+        try:
+            result = self.datastore.query(
+                int(seg), hours=hours,
+                percentiles=tuple(params.get("percentiles")
+                                  or DEFAULT_PERCENTILES))
+        except (TypeError, ValueError) as e:
+            return 400, json.dumps({"error": str(e)})
+        return 200, json.dumps(result, separators=(",", ":"))
 
     def report_many(self, traces) -> list:
         """Match + report a whole list — or a columnar
@@ -148,10 +182,44 @@ def make_handler(service: ReporterService):
             self.end_headers()
             self.wfile.write(raw)
 
+        def _parse_histogram(self, post: bool) -> dict:
+            """Histogram params: JSON body / ``json=`` like /report, or
+            bare GET query params (``segment_id=…&hours=7-9``)."""
+            params = urllib.parse.parse_qs(
+                urllib.parse.urlsplit(self.path).query)
+            if post or "json" in params:
+                return self._parse(post)
+            out: dict = {}
+            if "segment_id" in params:
+                out["segment_id"] = int(params["segment_id"][0])
+            if "hours" in params:
+                from ..datastore import parse_hours_spec
+                out["hours"] = parse_hours_spec(params["hours"][0])
+            if "t0" in params and "t1" in params:
+                out["time_range"] = [int(params["t0"][0]),
+                                     int(params["t1"][0])]
+            if "percentiles" in params:
+                out["percentiles"] = [
+                    float(p) for p in params["percentiles"][0].split(",") if p]
+            return out
+
         def _do(self, post: bool):
             action = urllib.parse.urlsplit(self.path).path.split("/")[-1]
             if action == "stats":
                 self._respond(200, json.dumps(metrics.snapshot()))
+                return
+            if action == "histogram":
+                try:
+                    params = self._parse_histogram(post)
+                except Exception as e:
+                    self._respond(400, json.dumps({"error": str(e)}))
+                    return
+                metrics.count("service.requests.histogram")
+                with metrics.timer("service.histogram"):
+                    code, body = service.histogram(params)
+                if code != 200:
+                    metrics.count(f"service.errors.{code}")
+                self._respond(code, body)
                 return
             try:
                 trace = self._parse(post)
@@ -237,12 +305,23 @@ def main(argv=None):
             "<host:port>\n")
         return 1
     try:
-        Configure(argv[0])
+        with open(argv[0]) as f:
+            conf = json.load(f)
+        Configure(conf)
         host, port = argv[1].split("/")[-1].split(":")
         port = int(port)
     except Exception as e:
         sys.stderr.write(f"Problem with config file: {e}\n")
         return 1
+
+    # a "datastore" key in the config (or REPORTER_TPU_DATASTORE) mounts
+    # a local histogram store under /histogram
+    datastore = None
+    ds_root = os.environ.get("REPORTER_TPU_DATASTORE") \
+        or conf.get("datastore")
+    if ds_root:
+        from ..datastore import LocalDatastore
+        datastore = LocalDatastore(ds_root)
 
     # pin the JAX platform before any decode can block on a chip tunnel
     # (REPORTER_TPU_PLATFORM=cpu|accel|auto; auto probes then falls back)
@@ -254,7 +333,7 @@ def main(argv=None):
     from ..parallel import init_multihost
     init_multihost()
 
-    service = ReporterService(SegmentMatcher())
+    service = ReporterService(SegmentMatcher(), datastore=datastore)
     httpd = BoundedThreadingHTTPServer((host, port), make_handler(service))
     try:
         httpd.serve_forever()

@@ -21,11 +21,13 @@ from __future__ import annotations
 
 import logging
 import os
+import tempfile
 import uuid as uuidlib
-from typing import Dict, List
+from typing import Dict, List, Optional
 
 from ..core.types import Segment, TimeQuantisedTile
 from ..utils import http as http_egress
+from ..utils import metrics
 
 logger = logging.getLogger("reporter_tpu.streaming")
 
@@ -53,9 +55,18 @@ def privacy_cull(segments: List[Segment], privacy: int) -> List[Segment]:
 
 class TileSink:
     """Where finished tiles go: file dir, http(s) endpoint, or s3 bucket
-    (reference: AnonymisingProcessor.java:85-101,177-220)."""
+    (reference: AnonymisingProcessor.java:85-101,177-220).
 
-    def __init__(self, output: str):
+    The reference swallows-and-logs egress failures (HttpClient.java:95-98)
+    — a flaky endpoint silently loses tiles. Here every outcome is counted
+    (``egress.ok`` / ``egress.fail`` in ``metrics.default``) and a failed
+    tile body is spooled to a dead-letter directory in the same
+    ``{t0}_{t1}/{level}/{tile_index}/{file}`` layout the file sink writes,
+    so ``python -m reporter_tpu datastore ingest --delete <dir>`` replays
+    it without loss or double counting.
+    """
+
+    def __init__(self, output: str, deadletter: Optional[str] = None):
         self.output = output.rstrip("/")
         self.is_bucket = self.output.endswith("amazonaws.com") or \
             self.output.startswith("s3://")
@@ -66,25 +77,53 @@ class TileSink:
             raise ValueError(f"Cannot PUT to {output} without a scheme")
         if not self.is_bucket and not self.is_http:
             os.makedirs(self.output, exist_ok=True)
+            default_dl = os.path.join(self.output, ".deadletter")
+        else:
+            # remote sink: spool locally at a stable ABSOLUTE path — a
+            # cwd-relative default would scatter spools across launch
+            # dirs (or hit an unwritable / under a service manager)
+            default_dl = os.path.join(tempfile.gettempdir(),
+                                      "reporter_tpu_deadletter")
+        self.deadletter = deadletter if deadletter is not None else default_dl
 
     def store(self, tile_name: str, file_name: str, payload: str) -> bool:
+        ok = False
         try:
             if self.is_http:
                 # signed PUT for AWS endpoints, plain POST otherwise
                 # (reference: AnonymisingProcessor.java:177-220)
-                return http_egress.egress_tile(
+                ok = http_egress.egress_tile(
                     self.output, tile_name + "/" + file_name, payload)
-            if self.is_bucket:  # s3:// form needs the SDK
-                return self._store_s3(tile_name + "/" + file_name, payload)
-            path = os.path.join(self.output, tile_name)
-            os.makedirs(path, exist_ok=True)
-            with open(os.path.join(path, file_name), "w") as f:
-                f.write(payload)
-            return True
+            elif self.is_bucket:  # s3:// form needs the SDK
+                ok = self._store_s3(tile_name + "/" + file_name, payload)
+            else:
+                path = os.path.join(self.output, tile_name)
+                os.makedirs(path, exist_ok=True)
+                with open(os.path.join(path, file_name), "w") as f:
+                    f.write(payload)
+                ok = True
         except Exception as e:
             logger.error("Couldn't flush tile to sink %s/%s: %s",
                          tile_name, file_name, e)
-            return False
+        if ok:
+            metrics.count("egress.ok")
+            return True
+        metrics.count("egress.fail")
+        self._spool(tile_name, file_name, payload)
+        return False
+
+    def _spool(self, tile_name: str, file_name: str, payload: str) -> None:
+        try:
+            path = os.path.join(self.deadletter, tile_name)
+            os.makedirs(path, exist_ok=True)
+            with open(os.path.join(path, file_name), "w") as f:
+                f.write(payload)
+            metrics.count("egress.deadletter")
+            logger.warning("Spooled failed tile to %s/%s/%s",
+                           self.deadletter, tile_name, file_name)
+        except Exception as e:  # spool is best-effort: never raise
+            logger.error("Dead-letter spool failed for %s/%s: %s",
+                         tile_name, file_name, e)
 
     def _store_s3(self, key: str, payload: str) -> bool:
         try:
@@ -103,7 +142,7 @@ class Anonymiser:
     """Stateful slice store + flush loop."""
 
     def __init__(self, sink: TileSink, privacy: int, quantisation: int,
-                 mode: str = "auto", source: str = "rtpu"):
+                 mode: str = "auto", source: str = "rtpu", tee=None):
         if privacy < 1:
             raise ValueError("Need a privacy parameter of 1 or more")
         if quantisation < 60:
@@ -113,6 +152,11 @@ class Anonymiser:
         self.quantisation = quantisation
         self.mode = mode.upper()
         self.source = source
+        # optional callable(tile, segments) fed every culled flush before
+        # egress — the zero-serialisation hook a co-located datastore uses
+        # (datastore.LocalDatastore.ingest_segments); a tee failure is
+        # logged but never blocks tile egress
+        self.tee = tee
         # tile -> current slice number; "tile.slice" -> segments
         self.slice_of: Dict[TimeQuantisedTile, int] = {}
         self.slices: Dict[str, List[Segment]] = {}
@@ -150,6 +194,12 @@ class Anonymiser:
                         tile, before, len(segments))
             if not segments:
                 continue
+            if self.tee is not None:
+                try:
+                    self.tee(tile, segments)
+                except Exception as e:
+                    logger.error("datastore tee failed for tile %s: %s",
+                                 tile, e)
             payload = "\n".join(
                 [Segment.column_layout()]
                 + [s.csv_row(self.mode, self.source) for s in segments])

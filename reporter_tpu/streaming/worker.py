@@ -230,6 +230,17 @@ def main(argv=None):
                         help="durable state snapshot path; restored on "
                              "start, saved every --state-interval seconds")
     parser.add_argument("--state-interval", type=float, default=30.0)
+    parser.add_argument("--datastore",
+                        help="local histogram-store directory: every "
+                             "flushed tile is ALSO aggregated in-process "
+                             "(zero serialisation) so /histogram queries "
+                             "work without a separate ingest step")
+    parser.add_argument("--deadletter",
+                        help="directory spooling tile bodies whose egress "
+                             "failed (default <output>/.deadletter for "
+                             "file sinks, <tmpdir>/reporter_tpu_deadletter "
+                             "for remote); replay with: datastore ingest "
+                             "--delete")
     args = parser.parse_args(argv)
 
     logging.basicConfig(level=logging.INFO,
@@ -269,10 +280,20 @@ def main(argv=None):
         from .state import StateStore
         state = StateStore(args.state_file, interval_s=args.state_interval)
 
+    tee = None
+    if args.datastore:
+        from ..datastore import LocalDatastore
+        datastore = LocalDatastore(args.datastore)
+        tee = lambda _tile, segments: \
+            datastore.ingest_segments(segments)  # noqa: E731
+
     worker = StreamWorker(
         Formatter.from_config(args.formatter), submit,
-        Anonymiser(TileSink(args.output_location), args.privacy,
-                   args.quantisation, mode=args.mode, source=args.source),
+        Anonymiser(TileSink(args.output_location,
+                            deadletter=args.deadletter),
+                   args.privacy,
+                   args.quantisation, mode=args.mode, source=args.source,
+                   tee=tee),
         mode=args.mode, reports=args.reports, transitions=args.transitions,
         flush_interval_s=args.flush_interval, state=state,
         uuid_filter=uuid_filter, submit_many=submit_many)

@@ -1,0 +1,88 @@
+"""Datastore CLI: ingest / compact / query / stats over a histogram store.
+
+  python -m reporter_tpu datastore ingest  <store> <results-dir> [--delete]
+  python -m reporter_tpu datastore compact <store> [--level L] [--index I]
+  python -m reporter_tpu datastore query   <store> --segment ID
+                                           [--hours 7-9|7,8,9]
+                                           [--t0 EPOCH --t1 EPOCH]
+                                           [--percentiles 25,50,75,95]
+  python -m reporter_tpu datastore stats   <store>
+
+``ingest`` replays any directory in the anonymiser's flush layout — a
+results dir OR its ``.deadletter`` spool; ``--delete`` removes each tile
+file after a successful append (the dead-letter replay contract). All
+output is one JSON object per line, metrics timers included, so the
+commands compose in scripts the way bench.py's artifact lines do.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from ..datastore import LocalDatastore, parse_hours_spec
+from ..utils import metrics
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="reporter-datastore", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p_ing = sub.add_parser("ingest", help="replay flushed tiles into the store")
+    p_ing.add_argument("store")
+    p_ing.add_argument("source", help="results or dead-letter directory")
+    p_ing.add_argument("--delete", action="store_true",
+                       help="remove each tile file after a successful "
+                            "append (dead-letter replay)")
+    p_ing.add_argument("--limit", type=int, default=None)
+
+    p_cmp = sub.add_parser("compact", help="merge partition deltas")
+    p_cmp.add_argument("store")
+    p_cmp.add_argument("--level", type=int, default=None)
+    p_cmp.add_argument("--index", type=int, default=None)
+
+    p_qry = sub.add_parser("query", help="one segment's speed histogram")
+    p_qry.add_argument("store")
+    p_qry.add_argument("--segment", type=int, required=True)
+    p_qry.add_argument("--hours", default=None,
+                       help="hour-of-week subset: '7-9' or '7,8,9'")
+    p_qry.add_argument("--t0", type=int, default=None,
+                       help="epoch range start (with --t1; alternative "
+                            "to --hours)")
+    p_qry.add_argument("--t1", type=int, default=None)
+    p_qry.add_argument("--percentiles", default=None,
+                       help="comma-separated, e.g. 25,50,75,95")
+
+    p_sts = sub.add_parser("stats", help="partition/segment/byte totals")
+    p_sts.add_argument("store")
+
+    args = parser.parse_args(argv)
+    ds = LocalDatastore(args.store)
+
+    if args.cmd == "ingest":
+        out = ds.ingest_dir(args.source, delete=args.delete,
+                            limit=args.limit)
+        out["metrics"] = metrics.snapshot()["timers"]
+    elif args.cmd == "compact":
+        out = ds.compact(level=args.level, index=args.index)
+    elif args.cmd == "query":
+        hours = parse_hours_spec(args.hours)
+        if hours is None and args.t0 is not None and args.t1 is not None:
+            from ..datastore import hours_for_range
+            hours = hours_for_range(args.t0, args.t1).tolist()
+        kwargs = {}
+        if args.percentiles:
+            kwargs["percentiles"] = [
+                float(p) for p in args.percentiles.split(",") if p]
+        out = ds.query(args.segment, hours=hours, **kwargs)
+    else:
+        out = ds.stats()
+
+    print(json.dumps(out, separators=(",", ":")))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -6,6 +6,10 @@ errors swallowed and logged with None returned (:95-98), and hand-rolled
 HMAC-SHA1 "AWS key:signature" authorization for S3 PUTs (:34-58) so tile
 egress needs no AWS SDK. Credentials come from the standard environment
 variables, as in the reference (AnonymisingProcessor.java:88-97).
+
+Retries sleep on a capped exponential schedule, and a ``Retry-After``
+header on 429/503 overrides it (the reference slept linearly and ignored
+throttling hints). See :func:`retry_delay` / :func:`parse_retry_after`.
 """
 from __future__ import annotations
 
@@ -27,6 +31,48 @@ ATTEMPTS = 3           # reference: HttpClient.java:88
 CONNECT_TIMEOUT = 1.0  # reference: HttpClient.java:81
 SOCKET_TIMEOUT = 10.0  # reference: HttpClient.java:83
 
+# retry schedule: exponential backoff with a cap (the reference — and the
+# first cut here — slept linearly and ignored throttling hints)
+BACKOFF_BASE_S = 0.5   # first retry delay; doubles each attempt
+BACKOFF_CAP_S = 30.0
+RETRY_AFTER_CAP_S = 60.0  # never trust a server to park us for longer
+
+
+def parse_retry_after(value: Optional[str],
+                      now: Optional[float] = None) -> Optional[float]:
+    """Parse a ``Retry-After`` header: delta-seconds or an HTTP-date
+    (RFC 9110 §10.2.3). Returns seconds to wait, or None if absent or
+    unparseable. ``now`` overrides the clock (tests)."""
+    if value is None:
+        return None
+    value = value.strip()
+    try:
+        return max(0.0, float(value))
+    except ValueError:
+        pass
+    try:
+        when = email.utils.parsedate_to_datetime(value)
+    except (TypeError, ValueError):
+        return None
+    if when is None:
+        return None
+    now = time.time() if now is None else now
+    return max(0.0, when.timestamp() - now)
+
+
+def retry_delay(attempt: int,
+                retry_after: Optional[float] = None) -> float:
+    """Seconds to sleep before retry number ``attempt`` (0-based).
+
+    The server's ``Retry-After`` wins when present (capped — a
+    misconfigured proxy must not park the flush loop for an hour);
+    otherwise exponential backoff from ``BACKOFF_BASE_S`` capped at
+    ``BACKOFF_CAP_S``.
+    """
+    if retry_after is not None:
+        return min(retry_after, RETRY_AFTER_CAP_S)
+    return min(BACKOFF_BASE_S * (2.0 ** attempt), BACKOFF_CAP_S)
+
 
 def aws_signature(sign_me: str, secret: str) -> str:
     """Base64(HMAC-SHA1(secret, sign_me)) (reference: HttpClient.java:34-40)."""
@@ -40,6 +86,7 @@ def _do(method: str, url: str, body: bytes,
     (reference: HttpClient.java:74-103). Returns the response body or None."""
     last = None
     for attempt in range(ATTEMPTS):
+        retry_after = None
         try:
             req = urllib.request.Request(url, data=body, method=method,
                                          headers=dict(headers))
@@ -49,7 +96,7 @@ def _do(method: str, url: str, body: bytes,
                 return resp.read().decode()
         except urllib.error.HTTPError as e:
             # the server answered; 4xx (except throttling) won't improve
-            # on retry
+            # on retry. 429/503 may carry Retry-After — honour it.
             last = e
             try:
                 e.read()
@@ -57,10 +104,13 @@ def _do(method: str, url: str, body: bytes,
                 pass
             if e.code < 500 and e.code != 429:
                 break
+            if e.code in (429, 503):
+                retry_after = parse_retry_after(
+                    e.headers.get("Retry-After") if e.headers else None)
         except Exception as e:
             last = e
         if attempt + 1 < ATTEMPTS:
-            time.sleep(CONNECT_TIMEOUT * (attempt + 1))
+            time.sleep(retry_delay(attempt, retry_after))
     logger.error("After %d attempts couldn't %s to %s -> %s",
                  ATTEMPTS, method, url, last)
     return None

@@ -141,6 +141,55 @@ class TestTiles:
         # max_tile_id=4049 (4 digits -> padded to 6)
         assert t.file_path(2415, 0, "gph") == "0/002/415.gph"
 
+    def test_max_edge_clamps_to_last_row_col(self):
+        # x == maxx / y == maxy belong to the last column/row, not -1
+        # (reference: get_tiles.py:41-60 edge handling)
+        for level in (0, 1, 2):
+            t = TileHierarchy().tiles(level)
+            assert t.col(180.0) == t.ncolumns - 1
+            assert t.row(90.0) == t.nrows - 1
+            assert t.tile_id(90.0, 180.0) == t.max_tile_id
+
+    def test_out_of_bbox_is_minus_one(self):
+        for level in (0, 1, 2):
+            t = TileHierarchy().tiles(level)
+            assert t.row(90.0 + 1e-9) == -1 and t.row(-90.0 - 1e-9) == -1
+            assert t.col(180.0 + 1e-9) == -1 and t.col(-180.0 - 1e-9) == -1
+            assert t.tile_id(91.0, 0.0) == -1
+            assert t.tile_id(0.0, 181.0) == -1
+
+    def test_tile_id_bbox_roundtrip_all_levels(self):
+        # id -> bbox -> id round-trips for interior points at every level
+        for level in (0, 1, 2):
+            t = TileHierarchy().tiles(level)
+            for tile_id in (0, 17, t.ncolumns - 1, t.ncolumns,
+                            t.max_tile_id // 2, t.max_tile_id):
+                box = t.tile_bbox(tile_id)
+                cy = (box.miny + box.maxy) / 2
+                cx = (box.minx + box.maxx) / 2
+                assert t.tile_id(cy, cx) == tile_id
+                # the min corner is inclusive; size matches the level
+                assert t.tile_id(box.miny, box.minx) == tile_id
+                assert box.maxx - box.minx == pytest.approx(t.tilesize)
+
+    def test_bbox_tile_id_roundtrip(self):
+        # lat/lon -> id -> bbox contains the original point
+        for level in (0, 1, 2):
+            t = TileHierarchy().tiles(level)
+            for lat, lon in ((14.6, 121.0), (-33.9, 151.2), (0.0, 0.0),
+                             (89.99, 179.99), (-90.0, -180.0)):
+                tid = t.tile_id(lat, lon)
+                box = t.tile_bbox(tid)
+                assert box.minx <= lon <= box.maxx
+                assert box.miny <= lat <= box.maxy
+
+    def test_tile_bbox_range_checked(self):
+        t = TileHierarchy().tiles(0)
+        with pytest.raises(ValueError):
+            t.tile_bbox(-1)
+        with pytest.raises(ValueError):
+            t.tile_bbox(t.max_tile_id + 1)
+
     def test_manila_bbox_contains_known_tile(self):
         # Manila ~ (14.6, 121.0)
         paths = list(tiles_for_bbox([120.9, 14.5, 121.1, 14.7], "gph"))

@@ -1,0 +1,525 @@
+"""Datastore tests: schema keys, the aggregation kernel, the append-only
+store (atomic commits, mmap reads, compaction), ingestion (CSV parity
+with the zero-serialisation path, dead-letter replay), the query surface,
+the /histogram service action, and the worker-flush round trip the ISSUE
+names as the acceptance proof."""
+import json
+import os
+import socket
+import threading
+import urllib.parse
+import urllib.request
+
+import numpy as np
+import pytest
+
+from reporter_tpu.core.osmlr import INVALID_SEGMENT_ID, make_segment_id
+from reporter_tpu.core.types import Segment
+from reporter_tpu.datastore import (
+    LocalDatastore,
+    ObservationBatch,
+    aggregate,
+    hours_for_range,
+    merge_deltas,
+    parse_tile_csv,
+)
+from reporter_tpu.datastore import schema
+from reporter_tpu.datastore.ingest import ingest_dir, scan_tiles
+from reporter_tpu.datastore.query import _percentiles
+from reporter_tpu.streaming.anonymiser import Anonymiser, TileSink
+
+# Monday 2017-01-02 08:00:00 UTC -> hour-of-week 8
+MON_8AM = 1483344000
+
+SID = make_segment_id(2, 756425, 10)
+NID = make_segment_id(2, 756425, 11)
+
+
+def _segs(n, t0=MON_8AM, duration=10.0, length=100, sid=SID, nid=NID,
+          spacing=30):
+    """n observations of `length` m in `duration` s (36 kph at defaults)."""
+    return [Segment(sid, nid, t0 + i * spacing, t0 + i * spacing + duration,
+                    length, 0) for i in range(n)]
+
+
+class TestSchema:
+    def test_hist_key_roundtrip(self):
+        seg = np.array([SID, NID, 1], dtype=np.int64)
+        hour = np.array([0, 8, 167])
+        sbin = np.array([0, 7, schema.N_SPEED_BINS - 1])
+        key = schema.hist_key(seg, hour, sbin)
+        s2, h2, b2 = schema.split_hist_key(key)
+        assert (s2 == seg).all() and (h2 == hour).all() and (b2 == sbin).all()
+
+    def test_keys_sort_by_segment_then_hour_then_bin(self):
+        lo, hi = schema.segment_key_range(SID)
+        assert hi - lo == schema.CELLS_PER_SEGMENT
+        below = schema.hist_key(np.array([SID - 1]), np.array([167]),
+                                np.array([schema.N_SPEED_BINS - 1]))[0]
+        assert below < lo
+
+    def test_max_key_fits_int64(self):
+        key = schema.hist_key(np.array([INVALID_SEGMENT_ID]),
+                              np.array([167]),
+                              np.array([schema.N_SPEED_BINS - 1]))
+        assert key.dtype == np.int64 and key[0] > 0
+
+    def test_hour_of_week_monday_epoch(self):
+        assert schema.hour_of_week(np.array([MON_8AM]))[0] == 8
+        # epoch 0 is Thursday 00:00 -> hour 72
+        assert schema.hour_of_week(np.array([0]))[0] == 72
+        # a week later, same hour
+        assert schema.hour_of_week(np.array([MON_8AM + 7 * 86400]))[0] == 8
+
+    def test_speed_bins(self):
+        kph = np.array([0.0, 4.99, 5.0, 36.0, 119.99, 120.0, 500.0])
+        bins = schema.speed_bin(kph)
+        assert bins.tolist() == [0, 0, 1, 7, 23, 24, 24]
+
+    def test_from_segments_matches_csv_parse(self):
+        segs = _segs(5, duration=9.2)  # fractional: exercises rounding
+        obs_a = ObservationBatch.from_segments(segs)
+        payload = "\n".join([Segment.column_layout()]
+                            + [s.csv_row("AUTO", "t") for s in segs])
+        obs_b = parse_tile_csv(payload)
+        for col in ("segment_id", "next_id", "duration_s", "count",
+                    "length_m", "queue_m", "min_ts", "max_ts"):
+            np.testing.assert_array_equal(getattr(obs_a, col),
+                                          getattr(obs_b, col), err_msg=col)
+
+    def test_valid_mask_drops_bad_rows(self):
+        segs = _segs(2) + [Segment(SID, NID, MON_8AM, MON_8AM, 100, 0),
+                           Segment(SID, NID, MON_8AM, MON_8AM + 10, 0, 0)]
+        obs = ObservationBatch.from_segments(segs)
+        assert obs.valid_mask().tolist() == [True, True, False, False]
+
+
+class TestAggregate:
+    def test_counts_and_speed_sums(self):
+        deltas = aggregate(ObservationBatch.from_segments(_segs(20)))
+        assert list(deltas) == [(2, 756425)]
+        d = deltas[(2, 756425)]
+        assert len(d) == 1  # one (segment, hour, bin) cell
+        assert d.hist_count[0] == 20
+        assert d.hist_speed_sum[0] == pytest.approx(20 * 36.0)
+        seg, hour, sbin = schema.split_hist_key(d.hist_key)
+        assert seg[0] == SID and hour[0] == 8 and sbin[0] == 7
+
+    def test_transitions_exclude_invalid_next(self):
+        segs = _segs(3) + [Segment(SID, None, MON_8AM, MON_8AM + 10, 100, 0)]
+        d = aggregate(ObservationBatch.from_segments(segs))[(2, 756425)]
+        assert d.trans_from.tolist() == [SID]
+        assert d.trans_to.tolist() == [NID]
+        assert d.trans_count.tolist() == [3]
+        # the invalid-next observation still lands in the histogram
+        assert d.hist_count.sum() == 4
+
+    def test_multi_partition_split(self):
+        other = make_segment_id(0, 99, 1)
+        segs = _segs(2) + _segs(3, sid=other, nid=None)
+        deltas = aggregate(ObservationBatch.from_segments(segs))
+        assert set(deltas) == {(2, 756425), (0, 99)}
+        assert deltas[(0, 99)].hist_count.sum() == 3
+
+    def test_merge_deltas_equals_single_pass(self):
+        a = _segs(10, duration=10.0)           # 36 kph
+        b = _segs(10, duration=20.0, spacing=60)  # 18 kph
+        d_all = aggregate(ObservationBatch.from_segments(a + b))[(2, 756425)]
+        d_merged = merge_deltas([
+            aggregate(ObservationBatch.from_segments(a))[(2, 756425)],
+            aggregate(ObservationBatch.from_segments(b))[(2, 756425)]])
+        np.testing.assert_array_equal(d_all.hist_key, d_merged.hist_key)
+        np.testing.assert_array_equal(d_all.hist_count, d_merged.hist_count)
+        np.testing.assert_allclose(d_all.hist_speed_sum,
+                                   d_merged.hist_speed_sum)
+        np.testing.assert_array_equal(d_all.trans_count, d_merged.trans_count)
+
+    def test_empty_batch(self):
+        assert aggregate(ObservationBatch.empty()) == {}
+
+
+class TestStore:
+    def test_append_commit_is_atomic(self, tmp_path):
+        ds = LocalDatastore(str(tmp_path))
+        ds.ingest_segments(_segs(5))
+        pdir = ds.partition_dir(2, 756425)
+        manifest = json.load(open(os.path.join(pdir, "MANIFEST.json")))
+        assert manifest["segments"] == ["delta-000001"]
+        # no temp debris after a clean commit
+        assert not [d for d in os.listdir(pdir) if d.startswith(".tmp")]
+        assert not os.path.exists(os.path.join(pdir, ".MANIFEST.tmp"))
+
+    def test_reads_are_mmapped(self, tmp_path):
+        ds = LocalDatastore(str(tmp_path))
+        ds.ingest_segments(_segs(5))
+        (part,) = ds.live_segments(2, 756425)
+        assert isinstance(part.hist_key, np.memmap)
+        assert isinstance(part.hist_speed_sum, np.memmap)
+
+    def test_compact_merges_and_preserves_query(self, tmp_path):
+        ds = LocalDatastore(str(tmp_path))
+        for _ in range(4):
+            ds.ingest_segments(_segs(5))
+        before = ds.query(SID)
+        assert ds.stats()["segments"] == 4
+        out = ds.compact()
+        assert out == {"partitions": 1, "merged_segments": 4}
+        assert ds.stats()["segments"] == 1
+        after = ds.query(SID)
+        assert after == before
+        # idempotent: single-segment partitions are left alone
+        assert ds.compact()["merged_segments"] == 0
+
+    def test_compact_filters_by_partition(self, tmp_path):
+        ds = LocalDatastore(str(tmp_path))
+        other = make_segment_id(0, 99, 1)
+        for _ in range(2):
+            ds.ingest_segments(_segs(2))
+            ds.ingest_segments(_segs(2, sid=other, nid=None))
+        assert ds.compact(level=0)["merged_segments"] == 2
+        assert ds.stats()["segments"] == 3  # level-2 partition untouched
+
+    def test_stats_counts(self, tmp_path):
+        ds = LocalDatastore(str(tmp_path))
+        ds.ingest_segments(_segs(20))
+        s = ds.stats()
+        assert s["partitions"] == 1 and s["segments"] == 1
+        assert s["rows"] == 20 and s["cells"] == 1 and s["bytes"] > 0
+
+    def test_unknown_partition_queries_empty(self, tmp_path):
+        ds = LocalDatastore(str(tmp_path))
+        r = ds.query(SID)
+        assert r["count"] == 0 and r["mean_kph"] is None
+        assert r["transitions"] == []
+
+
+class TestIngestDir:
+    def _flush_layout(self, root, segs, name="rtpu.abc123"):
+        tile_dir = os.path.join(root, "1483344000_1483347599", "2", "756425")
+        os.makedirs(tile_dir, exist_ok=True)
+        payload = "\n".join([Segment.column_layout()]
+                            + [s.csv_row("AUTO", "t") for s in segs])
+        with open(os.path.join(tile_dir, name), "w") as f:
+            f.write(payload)
+
+    def test_scan_skips_deadletter_and_dotfiles(self, tmp_path):
+        self._flush_layout(str(tmp_path), _segs(2))
+        self._flush_layout(os.path.join(str(tmp_path), ".deadletter"),
+                           _segs(2), name="rtpu.spooled")
+        open(os.path.join(str(tmp_path), ".state"), "w").close()
+        files = list(scan_tiles(str(tmp_path)))
+        assert len(files) == 1 and files[0].endswith("rtpu.abc123")
+
+    def test_ingest_dir_and_delete(self, tmp_path):
+        out_dir = tmp_path / "results"
+        self._flush_layout(str(out_dir), _segs(5))
+        self._flush_layout(str(out_dir), _segs(3), name="rtpu.def456")
+        ds = LocalDatastore(str(tmp_path / "store"))
+        got = ingest_dir(ds, str(out_dir), delete=True)
+        assert got == {"files": 2, "rows": 8, "failures": 0}
+        assert list(scan_tiles(str(out_dir))) == []  # replay-safe
+        assert ds.query(SID)["count"] == 8
+
+    def test_corrupt_file_counted_not_fatal(self, tmp_path):
+        out_dir = tmp_path / "results"
+        self._flush_layout(str(out_dir), _segs(2))
+        bad = os.path.join(str(out_dir), "1483344000_1483347599", "2",
+                           "756425", "rtpu.bad")
+        with open(bad, "w") as f:
+            f.write("segment_id,\nnot,a,tile")
+        ds = LocalDatastore(str(tmp_path / "store"))
+        got = ingest_dir(ds, str(out_dir))
+        # short rows are dropped row-wise, so the bad file parses to empty
+        assert got["files"] == 2 and got["rows"] == 2
+
+    def test_failing_file_quarantined_not_replayed(self, tmp_path):
+        # a 10-column row with a non-numeric id raises in the columnar
+        # conversion — the file must be quarantined so the next replay
+        # cannot double-count any partially committed partitions
+        out_dir = tmp_path / "results"
+        self._flush_layout(str(out_dir), _segs(2))
+        bad = os.path.join(str(out_dir), "1483344000_1483347599", "2",
+                           "756425", "rtpu.poison")
+        with open(bad, "w") as f:
+            f.write("nan?,,1,1,100,0,10,20,s,AUTO")
+        ds = LocalDatastore(str(tmp_path / "store"))
+        got = ingest_dir(ds, str(out_dir))
+        assert got["failures"] == 1 and got["files"] == 1
+        assert not os.path.exists(bad)
+        assert os.path.exists(os.path.join(os.path.dirname(bad),
+                                           ".rtpu.poison.failed"))
+        # the quarantined file is invisible to the next replay
+        again = ingest_dir(ds, str(out_dir))
+        assert again == {"files": 1, "rows": 2, "failures": 0}
+
+
+class TestDeadLetterReplay:
+    def test_failed_egress_spools_and_replays(self, tmp_path, monkeypatch):
+        from reporter_tpu.utils import metrics
+        metrics.default.reset()
+        # an http sink whose endpoint is down, spooling under tmp
+        dl = str(tmp_path / "dl")
+        monkeypatch.setattr("reporter_tpu.utils.http.egress_tile",
+                            lambda *a, **kw: False)
+        sink = TileSink("http://127.0.0.1:9", deadletter=dl)
+        anon = Anonymiser(sink, privacy=1, quantisation=3600, source="t")
+        for s in _segs(6):
+            anon.process("k", s)
+        assert anon.punctuate() == 0  # nothing written to the sink
+        snap = metrics.snapshot()["counters"]
+        assert snap["egress.fail"] == 1 and "egress.ok" not in snap
+        assert snap["egress.deadletter"] == 1
+        # the spool replays into a store with the standard ingest
+        ds = LocalDatastore(str(tmp_path / "store"))
+        got = ingest_dir(ds, dl, delete=True)
+        assert got["files"] == 1 and got["rows"] == 6
+        assert ds.query(SID)["count"] == 6
+        assert list(scan_tiles(dl)) == []
+
+    def test_ok_egress_counts(self, tmp_path):
+        from reporter_tpu.utils import metrics
+        metrics.default.reset()
+        sink = TileSink(str(tmp_path / "out"))
+        assert sink.store("1_2/2/756425", "t.x", "payload")
+        assert metrics.snapshot()["counters"]["egress.ok"] == 1
+
+    def test_local_sink_default_deadletter_inside_output(self, tmp_path):
+        sink = TileSink(str(tmp_path / "out"))
+        assert sink.deadletter == str(tmp_path / "out" / ".deadletter")
+
+    def test_remote_sink_default_deadletter_is_absolute(self):
+        # a cwd-relative spool would scatter across launch dirs (or hit
+        # an unwritable / under a service manager)
+        sink = TileSink("http://example.invalid/tiles")
+        assert os.path.isabs(sink.deadletter)
+
+
+class TestQuery:
+    def test_mean_and_percentiles_two_speed_cohorts(self, tmp_path):
+        ds = LocalDatastore(str(tmp_path))
+        # 30 obs at 36 kph (bin 7) + 10 obs at 72 kph (bin 14)
+        ds.ingest_segments(_segs(30, duration=10.0)
+                           + _segs(10, duration=5.0, spacing=40))
+        r = ds.query(SID)
+        assert r["count"] == 40
+        assert r["mean_kph"] == pytest.approx((30 * 36 + 10 * 72) / 40)
+        # p50 inside bin 7: 35 + (20-0)/30 * 5
+        assert r["percentiles"]["p50"] == pytest.approx(35 + 20 / 30 * 5,
+                                                        abs=1e-3)
+        # p95: target 38 -> bin 14: 70 + (38-30)/10 * 5
+        assert r["percentiles"]["p95"] == pytest.approx(70 + 8 / 10 * 5,
+                                                        abs=1e-3)
+        hist = np.array(r["histogram"]["counts"])
+        assert hist[7] == 30 and hist[14] == 10 and hist.sum() == 40
+
+    def test_hours_filter_and_coverage(self, tmp_path):
+        ds = LocalDatastore(str(tmp_path))
+        ds.ingest_segments(_segs(10))                       # hour 8
+        ds.ingest_segments(_segs(5, t0=MON_8AM + 7200))     # hour 10
+        r_all = ds.query(SID)
+        assert r_all["count"] == 15 and r_all["hours_covered"] == 2
+        r_8 = ds.query(SID, hours=[8])
+        assert r_8["count"] == 10 and r_8["coverage"] == 1.0
+        r_peak = ds.query(SID, hours=range(7, 10))
+        assert r_peak["count"] == 10
+        assert r_peak["coverage"] == pytest.approx(1 / 3, abs=1e-4)
+        with pytest.raises(ValueError):
+            ds.query(SID, hours=[400])
+
+    def test_hours_for_range(self):
+        np.testing.assert_array_equal(
+            hours_for_range(MON_8AM, MON_8AM + 3 * 3600), [8, 9, 10])
+        # mid-hour end still covers its hour
+        np.testing.assert_array_equal(
+            hours_for_range(MON_8AM, MON_8AM + 3600 + 1), [8, 9])
+        # a full week (or more) is every hour
+        assert hours_for_range(MON_8AM, MON_8AM + 8 * 86400).size == 168
+        assert hours_for_range(MON_8AM, MON_8AM).size == 0
+
+    def test_transitions_ranked(self, tmp_path):
+        ds = LocalDatastore(str(tmp_path))
+        nid2 = make_segment_id(2, 756425, 12)
+        ds.ingest_segments(_segs(3) + _segs(8, nid=nid2, spacing=40))
+        r = ds.query(SID)
+        assert r["transitions"] == [{"next_id": nid2, "count": 8},
+                                    {"next_id": NID, "count": 3}]
+
+    def test_percentiles_empty(self):
+        out = _percentiles(np.zeros(schema.N_SPEED_BINS, dtype=np.int64),
+                           (50.0,))
+        assert out == {"p50": None}
+
+    def test_percentiles_out_of_range_rejected(self, tmp_path):
+        ds = LocalDatastore(str(tmp_path))
+        ds.ingest_segments(_segs(5))
+        for bad in (150, 0, -5):
+            with pytest.raises(ValueError):
+                ds.query(SID, percentiles=(bad,))
+
+    def test_parse_hours_spec(self):
+        from reporter_tpu.datastore import parse_hours_spec
+        assert parse_hours_spec(None) is None
+        assert parse_hours_spec("7-9") == [7, 8, 9]
+        assert parse_hours_spec("7,8,9") == [7, 8, 9]
+        with pytest.raises(ValueError):
+            parse_hours_spec("9-7")
+
+
+class _StubMatcher:
+    def match_many(self, traces):
+        return [[] for _ in traces]
+
+
+@pytest.fixture
+def histogram_server(tmp_path):
+    from reporter_tpu.service.server import ReporterService, serve
+    ds = LocalDatastore(str(tmp_path / "store"))
+    ds.ingest_segments(_segs(20))
+    service = ReporterService(_StubMatcher(), datastore=ds)
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    httpd = serve(service, "127.0.0.1", port)
+    yield f"http://127.0.0.1:{port}", ds
+    httpd.shutdown()
+
+
+def _get(url):
+    try:
+        with urllib.request.urlopen(url) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+class TestHistogramAction:
+    def test_get_flat_params(self, histogram_server):
+        url, _ds = histogram_server
+        code, body = _get(f"{url}/histogram?segment_id={SID}")
+        assert code == 200
+        assert body["count"] == 20
+        assert body["mean_kph"] == pytest.approx(36.0)
+        assert body["transitions"][0]["next_id"] == NID
+
+    def test_get_hours_range(self, histogram_server):
+        url, _ds = histogram_server
+        code, body = _get(f"{url}/histogram?segment_id={SID}&hours=7-9")
+        assert code == 200 and body["count"] == 20
+        code, body = _get(f"{url}/histogram?segment_id={SID}&hours=10,11")
+        assert code == 200 and body["count"] == 0
+
+    def test_get_time_range(self, histogram_server):
+        url, _ds = histogram_server
+        code, body = _get(
+            f"{url}/histogram?segment_id={SID}&t0={MON_8AM}&t1={MON_8AM + 3600}")
+        assert code == 200 and body["count"] == 20
+
+    def test_post_json_body(self, histogram_server):
+        url, _ds = histogram_server
+        req = urllib.request.Request(
+            url + "/histogram",
+            data=json.dumps({"segment_id": SID,
+                             "percentiles": [50]}).encode(),
+            method="POST")
+        with urllib.request.urlopen(req) as r:
+            body = json.loads(r.read())
+        assert list(body["percentiles"]) == ["p50"]
+
+    def test_get_json_param(self, histogram_server):
+        url, _ds = histogram_server
+        q = urllib.parse.urlencode({"json": json.dumps({"segment_id": SID})})
+        code, body = _get(f"{url}/histogram?{q}")
+        assert code == 200 and body["count"] == 20
+
+    def test_bad_percentiles_400(self, histogram_server):
+        url, _ds = histogram_server
+        code, body = _get(f"{url}/histogram?segment_id={SID}"
+                          "&percentiles=150")
+        assert code == 400 and "percentile" in body["error"]
+
+    def test_missing_segment_id_400(self, histogram_server):
+        url, _ds = histogram_server
+        code, body = _get(url + "/histogram")
+        assert code == 400 and "segment_id" in body["error"]
+
+    def test_no_datastore_503(self):
+        from reporter_tpu.service.server import ReporterService
+        service = ReporterService(_StubMatcher())
+        code, body = service.histogram({"segment_id": SID})
+        assert code == 503
+
+
+class TestWorkerRoundTrip:
+    """The acceptance proof: a StreamWorker flush is ingested (both via
+    the tee and via CSV files), compacted, and queried with the expected
+    mean speed — and the two ingest paths agree exactly."""
+
+    def test_flush_ingest_compact_query(self, tmp_path):
+        from reporter_tpu.matcher import SegmentMatcher
+        from reporter_tpu.service.server import ReporterService
+        from reporter_tpu.streaming.formatter import Formatter
+        from reporter_tpu.streaming.worker import StreamWorker, \
+            inproc_submitter
+        from reporter_tpu.synth import build_grid_city, generate_trace
+
+        city = build_grid_city(rows=10, cols=10, spacing_m=200.0, seed=5,
+                               service_road_fraction=0.0,
+                               internal_fraction=0.0)
+        service = ReporterService(SegmentMatcher(net=city), threshold_sec=15,
+                                  max_batch=64, max_wait_ms=5.0)
+        out_dir = str(tmp_path / "results")
+        tee_store = LocalDatastore(str(tmp_path / "store_tee"))
+
+        rng = np.random.default_rng(9)
+        lines = []
+        for i in range(6):
+            tr = None
+            while tr is None:
+                tr = generate_trace(city, f"veh-{i}", rng, noise_m=3.0,
+                                    min_route_edges=8)
+            for p in tr.points:
+                lines.append("|".join([
+                    "x", tr.uuid, str(p["lat"]), str(p["lon"]),
+                    str(p["time"]), str(p["accuracy"])]))
+
+        anon = Anonymiser(TileSink(out_dir), privacy=1, quantisation=3600,
+                          source="test",
+                          tee=lambda _t, segs:
+                          tee_store.ingest_segments(segs))
+        worker = StreamWorker(
+            Formatter.from_config(",sv,\\|,1,2,3,4,5"),
+            inproc_submitter(service), anon, flush_interval_s=1e9)
+        worker.run(lines)
+        assert worker.parse_failures == 0
+
+        # CSV path: ingest the flushed tiles into a second store
+        csv_store = LocalDatastore(str(tmp_path / "store_csv"))
+        got = ingest_dir(csv_store, out_dir)
+        assert got["files"] > 0 and got["failures"] == 0
+        assert got["rows"] > 0
+
+        # both paths agree before and after compaction
+        tee_stats = tee_store.stats()
+        assert tee_stats["rows"] == got["rows"]
+        csv_store.compact()
+        tee_store.compact()
+        seg_ids = set()
+        for level, index in csv_store.partitions():
+            for part in csv_store.live_segments(level, index):
+                seg_ids.update(
+                    schema.split_hist_key(np.asarray(part.hist_key))[0]
+                    .tolist())
+        assert seg_ids, "no segments aggregated"
+        total = 0
+        for sid in sorted(seg_ids):
+            a = csv_store.query(sid)
+            b = tee_store.query(sid)
+            assert a == b
+            total += a["count"]
+            if a["count"]:
+                # synthetic city traces drive ~10-60 kph; a histogram
+                # mean outside that band means the speed math broke
+                assert 5.0 < a["mean_kph"] < 80.0
+                ps = a["percentiles"]
+                assert ps["p25"] <= ps["p50"] <= ps["p75"] <= ps["p95"]
+        assert total == got["rows"]

@@ -13,7 +13,7 @@ from reporter_tpu.utils import http as rhttp
 @pytest.fixture
 def server():
     """Local HTTP server recording requests; scriptable status codes."""
-    state = {"requests": [], "codes": []}
+    state = {"requests": [], "codes": [], "headers": []}
 
     class Handler(http.server.BaseHTTPRequestHandler):
         def _handle(self):
@@ -25,9 +25,12 @@ def server():
                 "body": self.rfile.read(length).decode(),
             })
             code = state["codes"].pop(0) if state["codes"] else 200
+            extra = state["headers"].pop(0) if state["headers"] else {}
             self.send_response(code)
             body = b"ok" if code == 200 else b"err"
             self.send_header("Content-Length", str(len(body)))
+            for k, v in extra.items():
+                self.send_header(k, v)
             self.end_headers()
             self.wfile.write(body)
 
@@ -66,6 +69,70 @@ class TestRetries:
         # reference: HttpClient.java:95-98 — errors swallowed, null returned
         monkeypatch.setattr(rhttp.time, "sleep", lambda s: None)
         assert rhttp.post("http://127.0.0.1:9/x", "v") is None
+
+
+class TestBackoffSchedule:
+    """The retry schedule, driven by a fake clock (no real sleeping)."""
+
+    def _sleeps(self, monkeypatch):
+        slept = []
+        monkeypatch.setattr(rhttp.time, "sleep", slept.append)
+        return slept
+
+    def test_exponential_schedule_on_5xx(self, server, monkeypatch):
+        slept = self._sleeps(monkeypatch)
+        server["codes"] = [500, 502, 500]
+        assert rhttp.post(server["url"] + "/x", "v") is None
+        # ATTEMPTS=3 -> two sleeps, doubling from BACKOFF_BASE_S
+        assert slept == [rhttp.BACKOFF_BASE_S, rhttp.BACKOFF_BASE_S * 2]
+
+    def test_backoff_is_capped(self):
+        assert rhttp.retry_delay(50) == rhttp.BACKOFF_CAP_S
+        assert rhttp.retry_delay(0) == rhttp.BACKOFF_BASE_S
+
+    def test_429_honours_retry_after_seconds(self, server, monkeypatch):
+        slept = self._sleeps(monkeypatch)
+        server["codes"] = [429]
+        server["headers"] = [{"Retry-After": "7"}]
+        assert rhttp.post(server["url"] + "/x", "v") == "ok"
+        assert slept == [7.0]
+
+    def test_503_honours_retry_after(self, server, monkeypatch):
+        slept = self._sleeps(monkeypatch)
+        server["codes"] = [503]
+        server["headers"] = [{"Retry-After": "2"}]
+        assert rhttp.post(server["url"] + "/x", "v") == "ok"
+        assert slept == [2.0]
+
+    def test_retry_after_capped(self, server, monkeypatch):
+        slept = self._sleeps(monkeypatch)
+        server["codes"] = [429]
+        server["headers"] = [{"Retry-After": "86400"}]
+        assert rhttp.post(server["url"] + "/x", "v") == "ok"
+        assert slept == [rhttp.RETRY_AFTER_CAP_S]
+
+    def test_429_without_header_backs_off_exponentially(self, server,
+                                                        monkeypatch):
+        slept = self._sleeps(monkeypatch)
+        server["codes"] = [429, 429]
+        assert rhttp.post(server["url"] + "/x", "v") == "ok"
+        assert slept == [rhttp.BACKOFF_BASE_S, rhttp.BACKOFF_BASE_S * 2]
+
+    def test_parse_retry_after_http_date(self):
+        # an HTTP-date is relative to the (injected) clock
+        now = 1700000000.0
+        date = rhttp.email.utils.formatdate(now + 42, usegmt=True)
+        got = rhttp.parse_retry_after(date, now=now)
+        assert got == pytest.approx(42.0, abs=1.0)
+
+    def test_parse_retry_after_past_date_clamps_to_zero(self):
+        now = 1700000000.0
+        date = rhttp.email.utils.formatdate(now - 500, usegmt=True)
+        assert rhttp.parse_retry_after(date, now=now) == 0.0
+
+    def test_parse_retry_after_garbage_is_none(self):
+        assert rhttp.parse_retry_after(None) is None
+        assert rhttp.parse_retry_after("soon") is None
 
 
 class TestAwsSigning:

@@ -111,6 +111,35 @@ class TestPrivacyCull:
         segs = [self._seg(1, 2), self._seg(1, 3)]
         assert len(privacy_cull(sorted(segs, key=Segment.sort_key), 1)) == 2
 
+    def test_run_exactly_at_threshold_survives(self):
+        segs = [self._seg(1, 2)] * 4
+        assert len(privacy_cull(segs, privacy=4)) == 4
+
+    def test_run_one_below_threshold_culled(self):
+        segs = [self._seg(1, 2)] * 3
+        assert privacy_cull(segs, privacy=4) == []
+
+    def test_adjacent_pairs_do_not_merge(self):
+        # (1,2)x2 then (1,3)x2: four same-id rows, but the runs are keyed
+        # on (id, next_id) — neither pair reaches privacy=3 by borrowing
+        # from its neighbour
+        segs = sorted([self._seg(1, 2)] * 2 + [self._seg(1, 3)] * 2,
+                      key=Segment.sort_key)
+        assert privacy_cull(segs, privacy=3) == []
+        # and at privacy=2 both distinct runs survive independently
+        out = privacy_cull(segs, privacy=2)
+        assert len(out) == 4
+        assert {s.sort_key() for s in out} == {(1, 2), (1, 3)}
+
+    def test_mixed_runs_cull_only_short_ones(self):
+        segs = sorted([self._seg(1, 2)] * 3 + [self._seg(1, 3)] * 2
+                      + [self._seg(2, 4)] * 3, key=Segment.sort_key)
+        out = privacy_cull(segs, privacy=3)
+        keys = [s.sort_key() for s in out]
+        assert keys.count((1, 2)) == 3
+        assert keys.count((2, 4)) == 3
+        assert (1, 3) not in keys
+
 
 class TestEndToEndReplay:
     """Replay synthetic sv-formatted probes through the full topology and

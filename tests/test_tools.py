@@ -113,6 +113,45 @@ class TestTilesCli:
             httpd.shutdown()
 
 
+class TestDatastoreCli:
+    def _flush(self, root, segs):
+        tile_dir = os.path.join(root, "1483344000_1483347599", "2", "756425")
+        os.makedirs(tile_dir, exist_ok=True)
+        with open(os.path.join(tile_dir, "t.abc"), "w") as f:
+            f.write("\n".join([Segment.column_layout()]
+                              + [s.csv_row("AUTO", "t") for s in segs]))
+
+    def test_ingest_compact_query_stats(self, capsys, tmp_path):
+        from reporter_tpu.core.osmlr import make_segment_id
+        from reporter_tpu.tools.datastore_cli import main
+        sid = make_segment_id(2, 756425, 10)
+        segs = [Segment(sid, None, 1483344000 + i * 30,
+                        1483344000 + i * 30 + 10, 100, 0) for i in range(8)]
+        results = tmp_path / "results"
+        store = str(tmp_path / "store")
+        self._flush(str(results), segs)
+
+        assert main(["ingest", store, str(results), "--delete"]) == 0
+        out = json.loads(capsys.readouterr().out)
+        assert out["files"] == 1 and out["rows"] == 8
+        assert "datastore.ingest.parse" in out["metrics"]
+        # --delete consumed the tile file (replay-safe)
+        assert not any(files for _r, _d, files in os.walk(results))
+
+        assert main(["compact", store]) == 0
+        assert json.loads(capsys.readouterr().out)["partitions"] == 1
+
+        assert main(["query", store, "--segment", str(sid),
+                     "--hours", "7-9", "--percentiles", "50"]) == 0
+        q = json.loads(capsys.readouterr().out)
+        assert q["count"] == 8 and q["mean_kph"] == pytest.approx(36.0)
+        assert list(q["percentiles"]) == ["p50"]
+
+        assert main(["stats", store]) == 0
+        s = json.loads(capsys.readouterr().out)
+        assert s["partitions"] == 1 and s["rows"] == 8
+
+
 class TestUmbrella:
     def test_unknown_command(self, capsys):
         from reporter_tpu.__main__ import main

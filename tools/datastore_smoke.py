@@ -1,0 +1,137 @@
+#!/usr/bin/env python
+"""CI smoke gate for the datastore's produce->consume loop.
+
+Runs synthetic traces through the REAL stack end-to-end, in-process:
+
+  StreamWorker (grid city, in-process matcher) flushes anonymised tiles
+  -> ``datastore ingest`` replays the flushed CSV dir into a store
+  -> ``datastore compact`` merges the deltas
+  -> a served ``/histogram`` HTTP query answers for an aggregated segment
+
+and asserts the response contract: counts survive ingest+compaction
+unchanged, the mean sits inside the synthetic city's plausible speed
+band, and the percentile CDF is monotone. A regression anywhere on the
+flush -> ingest -> store -> query path fails CI here, with the service
+surface (not just library calls) on the hook.
+"""
+import json
+import os
+import socket
+import sys
+import tempfile
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("REPORTER_TPU_PLATFORM", "cpu")  # CI: never probe
+
+
+def fail(msg: str) -> int:
+    sys.stderr.write(f"datastore smoke: {msg}\n")
+    return 1
+
+
+def main() -> int:
+    import numpy as np
+
+    from reporter_tpu.datastore import LocalDatastore, ingest_dir
+    from reporter_tpu.matcher import SegmentMatcher
+    from reporter_tpu.service.server import ReporterService, serve
+    from reporter_tpu.streaming.anonymiser import Anonymiser, TileSink
+    from reporter_tpu.streaming.formatter import Formatter
+    from reporter_tpu.streaming.worker import StreamWorker, inproc_submitter
+    from reporter_tpu.synth import build_grid_city, generate_trace
+
+    with tempfile.TemporaryDirectory() as tmp:
+        city = build_grid_city(rows=10, cols=10, spacing_m=200.0, seed=5,
+                               service_road_fraction=0.0,
+                               internal_fraction=0.0)
+        service = ReporterService(SegmentMatcher(net=city),
+                                  threshold_sec=15, max_batch=64,
+                                  max_wait_ms=5.0)
+        out_dir = os.path.join(tmp, "results")
+
+        rng = np.random.default_rng(9)
+        lines = []
+        for i in range(16):
+            tr = None
+            while tr is None:
+                tr = generate_trace(city, f"veh-{i}", rng, noise_m=3.0,
+                                    min_route_edges=8)
+            for p in tr.points:
+                lines.append("|".join([
+                    "x", tr.uuid, str(p["lat"]), str(p["lon"]),
+                    str(p["time"]), str(p["accuracy"])]))
+
+        worker = StreamWorker(
+            Formatter.from_config(",sv,\\|,1,2,3,4,5"),
+            inproc_submitter(service),
+            Anonymiser(TileSink(out_dir), privacy=1, quantisation=3600,
+                       source="smoke"),
+            flush_interval_s=1e9)
+        worker.run(lines)
+        if worker.parse_failures:
+            return fail(f"{worker.parse_failures} parse failures")
+
+        store_dir = os.path.join(tmp, "store")
+        ds = LocalDatastore(store_dir)
+        got = ingest_dir(ds, out_dir)
+        if not got["files"] or not got["rows"] or got["failures"]:
+            return fail(f"ingest: {got}")
+        compacted = ds.compact()
+        stats = ds.stats()
+        if stats["rows"] != got["rows"]:
+            return fail(f"compaction changed row count: "
+                        f"{stats['rows']} != {got['rows']}")
+        if stats["segments"] != stats["partitions"]:
+            return fail(f"compaction left deltas behind: {stats}")
+
+        # the busiest segment, found via the store's own partitions
+        from reporter_tpu.datastore import schema
+        best, best_count = None, 0
+        for level, index in ds.partitions():
+            for part in ds.live_segments(level, index):
+                seg_ids = schema.split_hist_key(
+                    np.asarray(part.hist_key))[0]
+                for sid in np.unique(seg_ids):
+                    c = int(np.asarray(part.hist_count)[seg_ids == sid].sum())
+                    if c > best_count:
+                        best, best_count = int(sid), c
+        if best is None:
+            return fail("no aggregated segments")
+
+        # serve it and query over HTTP — the real /histogram surface
+        service_q = ReporterService(SegmentMatcher(net=city), datastore=ds)
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        httpd = serve(service_q, "127.0.0.1", port)
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/histogram?segment_id={best}",
+                    timeout=30) as r:
+                body = json.loads(r.read())
+        finally:
+            httpd.shutdown()
+
+        if body["count"] != best_count:
+            return fail(f"query count {body['count']} != stored "
+                        f"{best_count}")
+        if not (5.0 < body["mean_kph"] < 80.0):
+            return fail(f"implausible mean speed {body['mean_kph']} kph")
+        ps = body["percentiles"]
+        if not (ps["p25"] <= ps["p50"] <= ps["p75"] <= ps["p95"]):
+            return fail(f"percentiles not monotone: {ps}")
+        if sum(body["histogram"]["counts"]) != body["count"]:
+            return fail("histogram counts disagree with total")
+
+        print(f"datastore smoke ok: {got['files']} tiles, {got['rows']} "
+              f"rows, {compacted['partitions']} partitions compacted, "
+              f"segment {best}: count={body['count']} "
+              f"mean={body['mean_kph']} kph p50={ps['p50']}")
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
