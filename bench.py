@@ -161,12 +161,23 @@ def _time_batched_leg(matcher, tb, reqs, make_report, repeats):
         elapsed = time.perf_counter() - t0
         if elapsed < best:
             best = elapsed
-            timers = metrics.snapshot()["timers"]
+            snap = metrics.snapshot()
+            timers = snap["timers"]
             best_stages = {
                 name.split(".", 1)[1]: timers[name]["total_s"]
                 for name in ("matcher.prep", "matcher.decode_dispatch",
                              "matcher.decode_wait", "matcher.assemble")
                 if name in timers}
+            # native prep phase split (REPORTER_TPU_PREP_TIMINGS
+            # attribution, now always exported through utils.metrics):
+            # candidates = wall of the batch-sorted kernel, select/routes
+            # are worker-thread-summed — where prep time went, committed
+            # in the artifact instead of needing a rerun
+            counters = snap["counters"]
+            for phase in ("candidates", "select", "routes"):
+                ns = counters.get(f"prep.phase.{phase}_ns")
+                if ns:
+                    best_stages[f"prep_{phase}"] = round(ns / 1e9, 6)
             best_stages["report"] = round(elapsed - (t_match - t0), 6)
             best_stages["total"] = round(elapsed, 6)
             # prep's share of the batch wall — the host-pipeline health
@@ -246,7 +257,11 @@ def main():
     from reporter_tpu.matcher.assemble import assemble_segments
     from reporter_tpu.matcher.cpu_ref import viterbi_decode_numpy
     from reporter_tpu.ops import decode_backend
-    from reporter_tpu.service.report import report as make_report
+    # report_json serialises the whole /report response: the batched leg
+    # takes the columnar writer (bytes straight from run columns), the
+    # baseline leg the reference-shaped dict + json.dumps path — each
+    # leg measures its own architecture end-to-end through the wire
+    from reporter_tpu.service.report import report_json as make_report
 
     platform = jax.devices()[0].platform
 
@@ -325,7 +340,8 @@ def main():
 
     print(json.dumps({
         "metric": f"synthetic-city traces/sec map-matched end-to-end "
-                  f"(columnar prep+decode+assemble+report, T={T_bucket}, "
+                  f"(columnar prep+decode+assemble+report-serialise, "
+                  f"T={T_bucket}, "
                   f"K={K}, platform={platform}, "
                   f"decode={decode_backend(T_bucket, K)}) "
                   f"batched match_many over a zero-dict TraceBatch vs "

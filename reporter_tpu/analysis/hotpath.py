@@ -12,14 +12,15 @@ HP001  per-element Python loop over trace/point data (a ``for`` statement
        the single sanctioned per-point pass lives in the declared edge
        functions (``points_to_columns`` and friends).
 HP002  dict construction inside a statement loop — the per-trace dict
-       builder pattern the columnar pipeline exists to kill. JSON
-       materialisation boundaries (the response payload builders) are
-       declared edge functions below, with their justification.
+       builder pattern the columnar pipeline exists to kill. Responses
+       serialise straight from run columns to JSON bytes (the columnar
+       writers in matcher/matcher.py and service/report.py); the
+       remaining edges below are wire-ingestion contracts.
 HP003  ``.item()`` anywhere, and ``.tolist()`` inside a loop *body*
        (a ``.tolist()`` in the ``for ... in <iter>`` header runs once and
        is the approved bulk-conversion idiom; per-iteration conversions
        pay fixed numpy overhead per element — the ~4k-tiny-tolist-calls
-       regression _runs_as_lists documents).
+       regression RunColumns documents).
 
 Edge functions are whitelisted by "relpath::qualname" with a reason; they
 are exactly the boundaries where per-element Python is the *contract*
@@ -71,15 +72,12 @@ EDGE_FUNCTIONS: Dict[str, str] = {
         "HTTP split-deployment JSON body (per-point dicts ARE the wire)",
     "reporter_tpu/streaming/batcher.py::Batch.request_columns":
         "columnarisation edge over Point structs (one pass per flush)",
-    # JSON response materialisation: the dicts ARE the output contract
-    "reporter_tpu/matcher/matcher.py::_format_runs":
-        "reference-schema response materialisation (dict per RUN, fed by "
-        "bulk-converted columns from _runs_as_lists)",
-    "reporter_tpu/matcher/matcher.py::_runs_as_lists":
-        "the approved bulk .tolist() conversion (one call per column)",
-    "reporter_tpu/service/report.py::report":
-        "datastore report emission — a sequential state machine over "
-        "segments producing the response JSON (reference semantics)",
+    # (the old JSON dict builders — _format_runs/_runs_as_lists and the
+    # dict-building report() state machine — are gone: the columnar
+    # response writer serialises run columns straight to JSON bytes
+    # (matcher.render_segments_json / service.report_json), and the
+    # emission scan accumulates parallel lists, so none of them need a
+    # per-element whitelist anymore)
     # numpy fallback assembler (native assemble_batch replaces it on the
     # hot path; this runs per trace only without the C++ runtime)
     "reporter_tpu/matcher/assemble.py::assemble_segments":

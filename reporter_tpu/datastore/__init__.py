@@ -35,10 +35,16 @@ from .store import HistogramStore
 class LocalDatastore(HistogramStore):
     """A histogram store plus its query surface, rooted at a directory."""
 
-    def ingest_segments(self, segments) -> int:
+    def ingest_segments(self, segments,
+                        max_deltas: Optional[int] = None,
+                        max_delta_bytes: Optional[int] = None) -> int:
         """Zero-serialisation path: aggregate culled ``Segment`` structs
-        straight out of the anonymiser's flush, no CSV round trip."""
-        return self.ingest(ObservationBatch.from_segments(segments))
+        straight out of the anonymiser's flush, no CSV round trip. With
+        compaction thresholds, the touched partitions are pressure-
+        checked inline (the worker tee's automatic-compaction knobs)."""
+        return self.ingest(ObservationBatch.from_segments(segments),
+                           max_deltas=max_deltas,
+                           max_delta_bytes=max_delta_bytes)
 
     def ingest_csv(self, payload: str) -> int:
         return self.ingest(parse_tile_csv(payload))

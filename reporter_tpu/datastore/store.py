@@ -36,6 +36,7 @@ import os
 import shutil
 import threading
 import time
+from collections import OrderedDict
 from typing import Dict, Iterator, List, Optional, Tuple
 
 import numpy as np
@@ -60,12 +61,32 @@ _COLUMNS = (
 
 class HistogramStore:
     """The local datastore: ingest observation batches, serve mmap'd
-    deltas to the query layer, compact partitions in place."""
+    deltas to the query layer, compact partitions in place.
 
-    def __init__(self, root: str):
+    Reads go through a bounded partition-handle LRU: one /histogram
+    request used to re-``np.load``/mmap every segment file of the
+    partition (ROADMAP's named serving-scale gap). Handles are keyed by
+    the manifest's segment list, so any committed append or compaction
+    invalidates naturally on the next read — the manifest itself is
+    still read per request (a tiny JSON open; it IS the invalidation
+    signal), only the mmap opens are amortised. Hit/miss counts surface
+    as ``datastore.query.cache.*`` on /stats.
+    """
+
+    def __init__(self, root: str, handle_cache_size: Optional[int] = None):
         self.root = root
         os.makedirs(root, exist_ok=True)
         self._lock = threading.Lock()
+        if handle_cache_size is None:
+            try:
+                handle_cache_size = int(os.environ.get(
+                    "REPORTER_TPU_DATASTORE_HANDLES", "") or 64)
+            except ValueError:
+                handle_cache_size = 64
+        self.handle_cache_size = max(0, handle_cache_size)
+        self._handle_lock = threading.Lock()
+        # (pdir, (segment names...)) -> [Delta] of live mmap handles
+        self._handles: "OrderedDict[tuple, List[Delta]]" = OrderedDict()
 
     # -- paths -------------------------------------------------------------
     def partition_dir(self, level: int, index: int) -> str:
@@ -127,13 +148,21 @@ class HistogramStore:
                        "created": time.time()}, f)
         os.replace(tmp, os.path.join(pdir, name))
 
-    def ingest(self, obs: ObservationBatch) -> int:
+    def ingest(self, obs: ObservationBatch,
+               max_deltas: Optional[int] = None,
+               max_delta_bytes: Optional[int] = None) -> int:
         """Aggregate + append a whole observation batch (possibly spanning
-        partitions). Returns the number of valid rows ingested."""
+        partitions). Returns the number of valid rows ingested. With
+        compaction thresholds set, each partition THIS batch touched is
+        pressure-checked right after its append — O(touched partitions),
+        not a store-wide sweep (the worker tee runs this on every flush)."""
         rows = 0
         for (level, index), delta in aggregate(obs).items():
             self.append(level, index, delta)
             rows += delta.rows
+            if max_deltas is not None or max_delta_bytes is not None:
+                self._maybe_compact_partition(level, index, max_deltas,
+                                              max_delta_bytes)
         return rows
 
     # -- read path ---------------------------------------------------------
@@ -150,31 +179,108 @@ class HistogramStore:
         return Delta(**cols)
 
     def live_segments(self, level: int, index: int) -> List[Delta]:
-        """Every committed delta of one partition, mmap'd (may be empty)."""
+        """Every committed delta of one partition, mmap'd (may be empty).
+
+        Handles come from the partition LRU when the manifest's segment
+        list is unchanged; a changed manifest (append/compaction) keys
+        differently and the stale entry ages out of the bound."""
         pdir = self.partition_dir(level, index)
         manifest = self._read_manifest(pdir)
+        key = (pdir, tuple(manifest["segments"]))
+        if self.handle_cache_size:
+            with self._handle_lock:
+                got = self._handles.get(key)
+                if got is not None:
+                    self._handles.move_to_end(key)
+                    metrics.count("datastore.query.cache.hits")
+                    return list(got)
+            # only a live cache counts misses: a disabled cache emitting
+            # a permanent 0% hit ratio reads as misconfiguration
+            metrics.count("datastore.query.cache.misses")
         out = []
         for name in manifest["segments"]:
             d = self.load_segment(pdir, name)
             if d is not None:
                 out.append(d)
+        if self.handle_cache_size:
+            with self._handle_lock:
+                # drop any stale handle list of this partition (older
+                # manifest) before inserting the fresh one
+                for stale in [k for k in self._handles if k[0] == pdir
+                              and k != key]:
+                    del self._handles[stale]
+                self._handles[key] = list(out)
+                self._handles.move_to_end(key)
+                while len(self._handles) > self.handle_cache_size:
+                    self._handles.popitem(last=False)
         return out
 
     # -- compaction --------------------------------------------------------
+    def _delta_pressure(self, pdir: str, names: List[str]) -> Tuple[int, int]:
+        """(count, bytes) of uncompacted ``delta-`` segments — the inputs
+        to the automatic compaction policy (a ``base-`` segment is
+        already compacted and exerts no pressure)."""
+        n = 0
+        total = 0
+        for name in names:
+            if not name.startswith("delta-"):
+                continue
+            n += 1
+            sdir = os.path.join(pdir, name)
+            try:
+                total += sum(os.path.getsize(os.path.join(sdir, f))
+                             for f in os.listdir(sdir))
+            except FileNotFoundError:
+                continue
+        return n, total
+
     def compact(self, level: Optional[int] = None,
-                index: Optional[int] = None) -> dict:
+                index: Optional[int] = None,
+                max_deltas: Optional[int] = None,
+                max_delta_bytes: Optional[int] = None) -> dict:
         """Merge each selected partition's segments into one ``base-``
-        segment. Returns ``{"partitions", "merged_segments"}``."""
-        merged = parts = 0
+        segment. With ``max_deltas`` / ``max_delta_bytes`` set this is
+        the *automatic policy*: only partitions whose uncompacted delta
+        count or byte total exceeds a threshold are compacted (the
+        worker's datastore tee and the CLI pass these, so steady-state
+        operation needs no manual compaction pass). Returns
+        ``{"partitions", "merged_segments", "skipped"}``."""
+        merged = parts = skipped = 0
+        thresholds = max_deltas is not None or max_delta_bytes is not None
         with metrics.timer("datastore.store.compact"):
             for lvl, idx in list(self.partitions()):
                 if level is not None and lvl != level:
                     continue
                 if index is not None and idx != index:
                     continue
+                if thresholds:
+                    got = self._maybe_compact_partition(
+                        lvl, idx, max_deltas, max_delta_bytes)
+                    if got is None:
+                        skipped += 1
+                    else:
+                        merged += got
+                        parts += 1
+                    continue
                 merged += self._compact_partition(lvl, idx)
                 parts += 1
-        return {"partitions": parts, "merged_segments": merged}
+        return {"partitions": parts, "merged_segments": merged,
+                "skipped": skipped}
+
+    def _maybe_compact_partition(self, level: int, index: int,
+                                 max_deltas: Optional[int],
+                                 max_delta_bytes: Optional[int]
+                                 ) -> Optional[int]:
+        """Compact ONE partition iff its uncompacted-delta pressure
+        crosses a threshold; None when below pressure (skipped)."""
+        pdir = self.partition_dir(level, index)
+        names = self._read_manifest(pdir)["segments"]
+        n, nbytes = self._delta_pressure(pdir, names)
+        if not ((max_deltas is not None and n > max_deltas) or
+                (max_delta_bytes is not None and nbytes > max_delta_bytes)):
+            return None
+        metrics.count("datastore.store.auto_compactions")
+        return self._compact_partition(level, index)
 
     def _compact_partition(self, level: int, index: int) -> int:
         with self._lock:

@@ -87,61 +87,183 @@ def pipeline_enabled() -> bool:
     return jax.default_backend() != "cpu"
 
 
-def _runs_as_lists(runs: dict) -> dict:
-    """Convert the native assembler's run columns to Python lists ONCE
-    per decoded batch. The old per-trace slice-and-tolist paid ~4k tiny
-    tolist calls per 512-trace chunk (8 columns x B slices, each with
-    fixed numpy overhead) — ~6 ms that one bulk conversion does in ~1."""
-    return {
-        "seg_id": runs["seg_id"].tolist(),
-        "internal": runs["internal"].astype(bool).tolist(),
-        # round HERE, whole column at once: _format_runs used to call
-        # round() twice per run dict (reporter-lint HP002 sweep)
-        "start": np.round(runs["start"], 3).tolist(),
-        "end": np.round(runs["end"], 3).tolist(),
-        "length": runs["length"].tolist(),
-        "queue": runs["queue"].tolist(),
-        "begin_idx": runs["begin_idx"].tolist(),
-        "end_idx": runs["end_idx"].tolist(),
-        "way_off": runs["way_off"].tolist(),
-        "ways": runs["ways"].tolist(),
-    }
+class RunColumns:
+    """One decoded chunk's run columns as Python lists — ONE bulk
+    ``.tolist()`` per column (the approved conversion idiom), shared by
+    every :class:`MatchRuns` view of the chunk. This replaced the old
+    per-trace ``_runs_as_lists`` slice-and-convert, which paid ~4k tiny
+    tolist calls per 512-trace chunk."""
+
+    __slots__ = ("seg_id", "internal", "start", "end", "length", "queue",
+                 "begin_idx", "end_idx", "way_off", "ways")
+
+    def __init__(self, runs: dict):
+        self.seg_id = runs["seg_id"].tolist()
+        self.internal = runs["internal"].astype(bool).tolist()
+        # round HERE, whole column at once (reporter-lint HP002 sweep:
+        # the dict-era formatter called round() twice per run)
+        self.start = np.round(runs["start"], 3).tolist()
+        self.end = np.round(runs["end"], 3).tolist()
+        self.length = runs["length"].tolist()
+        self.queue = runs["queue"].tolist()
+        self.begin_idx = runs["begin_idx"].tolist()
+        self.end_idx = runs["end_idx"].tolist()
+        self.way_off = runs["way_off"].tolist()
+        self.ways = runs["ways"].tolist()
 
 
-def _format_runs(cols: dict, lo: int, hi: int, mode: str) -> dict:
-    """Run columns (as Python lists, see :func:`_runs_as_lists`)
-    [lo, hi) -> the reference-schema match dict (same keys/values as
-    matcher.assemble.assemble_segments; reference: README.md "Reporter
-    Output")."""
-    n = hi - lo
-    if n <= 0:
-        return {"segments": [], "mode": mode}
-    seg_id = cols["seg_id"]
-    internal = cols["internal"]
-    start = cols["start"]
-    end = cols["end"]
-    length = cols["length"]
-    queue = cols["queue"]
-    begin_idx = cols["begin_idx"]
-    end_idx = cols["end_idx"]
-    way_off = cols["way_off"]
-    ways = cols["ways"]
-    segments = []
+def _jnum(x) -> str:
+    """One JSON scalar, byte-identical to ``json.dumps(x)``: floats via
+    ``float.__repr__`` (with the Infinity/NaN spellings), bools/None as
+    their JSON literals, ints via ``str``."""
+    if x is True:
+        return "true"
+    if x is False:
+        return "false"
+    if x is None:
+        return "null"
+    if isinstance(x, float):
+        if x != x:
+            return "NaN"
+        if x == float("inf"):
+            return "Infinity"
+        if x == float("-inf"):
+            return "-Infinity"
+        return repr(x)
+    return str(x)
+
+
+def render_segments_json(cols: RunColumns, lo: int, hi: int,
+                         mode: str) -> str:
+    """Serialise run columns [lo, hi) straight to the reference-schema
+    ``{"segments":[...],"mode":...}`` JSON — byte-identical to
+    ``json.dumps`` over the per-run dicts the old ``_format_runs``
+    materialised (pinned by tests/test_report_writer.py). This is the
+    columnar response writer: the hot serving path emits bytes from the
+    columns and never builds a per-run dict. Start/end times are always
+    finite floats here (rounded probe epochs / -1.0 sentinels), so they
+    format through bare ``repr`` — identical bytes to json.dumps's
+    ``float.__repr__`` path, without the per-value type dispatch."""
+    way_off, ways = cols.way_off, cols.ways
+    start, end, length = cols.start, cols.end, cols.length
+    queue, internal = cols.queue, cols.internal
+    begin_idx, end_idx, seg_id = cols.begin_idx, cols.end_idx, cols.seg_id
+    parts = []
     for r in range(lo, hi):
-        entry = {
-            "way_ids": ways[way_off[r]:way_off[r + 1]],
-            "start_time": start[r],
-            "end_time": end[r],
-            "length": length[r],
-            "queue_length": queue[r],
-            "internal": internal[r],
-            "begin_shape_index": begin_idx[r],
-            "end_shape_index": end_idx[r],
-        }
-        if seg_id[r] >= 0:
-            entry["segment_id"] = seg_id[r]
-        segments.append(entry)
-    return {"segments": segments, "mode": mode}
+        w = ",".join(map(str, ways[way_off[r]:way_off[r + 1]]))
+        sid = seg_id[r]
+        parts.append(
+            f'{{"way_ids":[{w}],'
+            f'"start_time":{start[r]!r},'
+            f'"end_time":{end[r]!r},'
+            f'"length":{length[r]},'
+            f'"queue_length":{queue[r]},'
+            f'"internal":{"true" if internal[r] else "false"},'
+            f'"begin_shape_index":{begin_idx[r]},'
+            f'"end_shape_index":{end_idx[r]}'
+            + (f',"segment_id":{sid}}}' if sid >= 0 else "}"))
+    mode_json = '"auto"' if mode == "auto" else json.dumps(mode)
+    return ('{"segments":[' + ",".join(parts) + '],"mode":'
+            + mode_json + "}")
+
+
+class MatchRuns:
+    """One trace's match result as a lazy view over its chunk's shared
+    :class:`RunColumns`.
+
+    Dict-shaped consumers (tests, the numpy-fallback comparisons, the
+    worker's structured report path) see the reference-schema match dict
+    through the mapping protocol below — the per-run dicts materialise
+    on first structural access, via one comprehension. The hot serving
+    path (``Match()`` and service ``report_json``) serialises straight
+    from the columns and never triggers it. Deliberately NOT a dict
+    subclass: ``json.dumps`` on a lazy dict subclass would silently
+    encode the un-materialised storage; here it fails loudly instead
+    (use the writers)."""
+
+    __slots__ = ("cols", "lo", "hi", "mode", "_dict")
+
+    def __init__(self, cols: RunColumns, lo: int, hi: int, mode: str):
+        self.cols = cols
+        self.lo = lo
+        self.hi = hi
+        self.mode = mode
+        self._dict = None
+
+    def _materialise(self) -> dict:
+        d = self._dict
+        if d is None:
+            c, lo, hi = self.cols, self.lo, self.hi
+            wo, ways = c.way_off, c.ways
+            segments = [
+                {"way_ids": ways[wo[r]:wo[r + 1]],
+                 "start_time": c.start[r],
+                 "end_time": c.end[r],
+                 "length": c.length[r],
+                 "queue_length": c.queue[r],
+                 "internal": c.internal[r],
+                 "begin_shape_index": c.begin_idx[r],
+                 "end_shape_index": c.end_idx[r],
+                 **({"segment_id": c.seg_id[r]}
+                    if c.seg_id[r] >= 0 else {})}
+                for r in range(lo, hi)]
+            d = self._dict = {"segments": segments, "mode": self.mode}
+        return d
+
+    def has_runs(self) -> bool:
+        """True when the match produced any segment run — an emptiness
+        probe that never materialises the per-run dicts (the streaming
+        batcher's trim logic only needs this bit)."""
+        return self.hi > self.lo
+
+    # -- mapping protocol (materialises) -----------------------------------
+    def __getitem__(self, key):
+        return self._materialise()[key]
+
+    def __setitem__(self, key, value):
+        if key == "mode":
+            # report() stamps mode without needing the segment dicts
+            self.mode = value
+            if self._dict is not None:
+                self._dict["mode"] = value
+            return
+        self._materialise()[key] = value
+
+    def get(self, key, default=None):
+        return self._materialise().get(key, default)
+
+    def __contains__(self, key):
+        return key in self._materialise()
+
+    def __iter__(self):
+        return iter(self._materialise())
+
+    def __len__(self):
+        return len(self._materialise())
+
+    def keys(self):
+        return self._materialise().keys()
+
+    def values(self):
+        return self._materialise().values()
+
+    def items(self):
+        return self._materialise().items()
+
+    def __eq__(self, other):
+        if isinstance(other, MatchRuns):
+            other = other._materialise()
+        if isinstance(other, dict):
+            return self._materialise() == other
+        return NotImplemented
+
+    __hash__ = None  # mutable mapping semantics, like dict
+
+    def __bool__(self):
+        return True  # a match result is always a non-empty mapping
+
+    def __repr__(self):
+        return repr(self._materialise())
 
 
 def Configure(conf) -> None:
@@ -230,6 +352,11 @@ class SegmentMatcher:
     def Match(self, trace_json: str) -> str:
         trace = json.loads(trace_json)
         result = self.match_many([trace])[0]
+        if isinstance(result, MatchRuns):
+            # columnar writer: JSON bytes straight from the run columns,
+            # byte-identical to json.dumps of the materialised dict
+            return render_segments_json(result.cols, result.lo, result.hi,
+                                        result.mode)
         return json.dumps(result, separators=(",", ":"))
 
     # -- batched hot path --------------------------------------------------
@@ -370,8 +497,10 @@ class SegmentMatcher:
             decoded = np.asarray(decoded)
         if batch.prep is not None:
             # native batched assembly: ONE call walks every decoded
-            # path of this batch into run records; Python only
-            # formats the reference-schema dicts
+            # path of this batch into run records; the results are lazy
+            # MatchRuns views over ONE shared RunColumns — no per-run
+            # dicts here, the serving path serialises straight from the
+            # columns (render_segments_json / service report_json)
             B = len(batch.traces)
             gp = per_trace_params[order[0]]
             with metrics.timer("matcher.assemble"):
@@ -383,11 +512,10 @@ class SegmentMatcher:
                     backward_tolerance_m=gp.backward_tolerance_m,
                     turn_penalty_factor=gp.turn_penalty_factor)
                 ro = runs["run_off"].tolist()
-                cols = _runs_as_lists(runs)
+                cols = RunColumns(runs)
                 for b, i in enumerate(order):
-                    results[i] = _format_runs(
-                        cols, ro[b], ro[b + 1],
-                        per_trace_params[i].mode)
+                    results[i] = MatchRuns(cols, ro[b], ro[b + 1],
+                                           per_trace_params[i].mode)
         else:
             # order is elementwise-aligned with batch.traces (the
             # dispatchers build it that way), so row b IS trace order[b]

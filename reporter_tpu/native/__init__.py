@@ -30,7 +30,7 @@ _LIB_PATH = os.path.join(_DIR, "libreporter_host.so")
 # Must equal host_runtime.cpp's rt_abi_version(). The handshake in
 # _get_lib() turns a half-landed ABI change (library and binding updated
 # in different commits) into a loud numpy fallback instead of a segfault.
-ABI_VERSION = 10
+ABI_VERSION = 11
 _lib = None
 _build_lock = threading.Lock()
 _build_failed = False
@@ -120,6 +120,8 @@ def _init_locked() -> Optional[ctypes.CDLL]:
         lib.rt_cache_clear.argtypes = [ctypes.c_void_p]
         lib.rt_cache_size.argtypes = [ctypes.c_void_p]
         lib.rt_cache_size.restype = ctypes.c_int64
+        c_i64arr = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+        lib.rt_route_memo_stats.argtypes = [ctypes.c_void_p, c_i64arr]
         lib.rt_candidates.argtypes = [
             ctypes.c_void_p, ctypes.c_int64, c_f64p, c_f64p, ctypes.c_int32,
             ctypes.c_double, c_i32p, c_f32p, c_f32p, c_f32p, c_f32p]
@@ -152,7 +154,7 @@ def _init_locked() -> Optional[ctypes.CDLL]:
             ctypes.c_double, ctypes.c_double, ctypes.c_double,
             ctypes.c_int32,
             c_i32p, c_f32p, c_f32p, c_f32p, c_f32p, c_i32p, c_i32p, c_i32p,
-            c_f32p, c_u8p, c_f32p]
+            c_f32p, c_u8p, c_f32p, c_i64p]
         i64ref = ctypes.POINTER(ctypes.c_int64)
         lib.rt_tile_counts.restype = ctypes.c_int32
         lib.rt_tile_counts.argtypes = [
@@ -370,6 +372,10 @@ class NativeRuntime:
             # wire-dtype decision reads this scalar instead of re-scanning
             # the tensors
             "max_finite": np.zeros(1, np.float32),
+            # phase split {candidates, select_pack, routes} ns — folded
+            # into utils.metrics below so the bench artifact can show
+            # where prep time went without rerunning under a profiler
+            "phase_ns": np.zeros(3, np.int64),
         }
         if rows > B:
             out["edge_ids"][B:] = PAD_EDGE
@@ -391,7 +397,12 @@ class NativeRuntime:
             out["edge_ids"], out["dist_m"], out["offset_m"],
             out["route_m"], out["gc_m"], out["case"], out["kept_idx"],
             out["num_kept"], out["dwell"], out["has_cands"],
-            out["max_finite"])
+            out["max_finite"], out["phase_ns"])
+        from ..utils import metrics
+        for name, ns in zip(("candidates", "select", "routes"),
+                            out["phase_ns"].tolist()):
+            if ns > 0:
+                metrics.count(f"prep.phase.{name}_ns", ns)
         return out
 
     def to_f16(self, arr: np.ndarray) -> np.ndarray:
@@ -494,3 +505,12 @@ class NativeRuntime:
 
     def cache_size(self) -> int:
         return int(self._lib.rt_cache_size(self._handle))
+
+    def route_memo_stats(self) -> dict:
+        """Counters of the cross-call (edge_from, edge_to) route-pair
+        memo (host_runtime.cpp PairMemo; capacity via
+        REPORTER_TPU_ROUTE_MEMO, read at runtime construction)."""
+        out = np.zeros(4, np.int64)
+        self._lib.rt_route_memo_stats(self._handle, out)
+        return {"hits": int(out[0]), "misses": int(out[1]),
+                "size": int(out[2]), "evictions": int(out[3])}

@@ -19,10 +19,13 @@
 #include <cfenv>
 #include <chrono>
 #include <cmath>
+#include <condition_variable>
 #include <cstdint>
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
+#include <functional>
+#include <memory>
 #include <mutex>
 #include <queue>
 #include <thread>
@@ -38,6 +41,276 @@ namespace {
 constexpr float kUnreachable = 1.0e9f;
 constexpr int32_t kPadEdge = -1;
 constexpr float kPadDist = 1.0e9f;
+
+// Persistent worker pool, one per Graph handle. rt_prepare_batch used to
+// spawn-and-join fresh std::threads every call; at service chunk sizes
+// that is two thread births per worker per chunk (candidate sweep +
+// trace phase) of pure overhead. Pool threads park on a condvar between
+// calls. run() is serialised (run_mu): concurrent rt_prepare_batch
+// callers on one handle queue up rather than corrupt the epoch state.
+class WorkerPool {
+ public:
+  ~WorkerPool() {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      stop_ = true;
+    }
+    cv_work_.notify_all();
+    for (auto& t : threads_) t.join();
+  }
+
+  // Run fn on `extra` pool threads plus the calling thread; fn must be an
+  // atomic-cursor loop (every participant pulls items until exhausted),
+  // so output never depends on which thread ran what. Blocks until all
+  // participants return.
+  void run(int extra, const std::function<void()>& fn) {
+    std::lock_guard<std::mutex> outer(run_mu_);
+    if (extra <= 0) {
+      fn();
+      return;
+    }
+    {
+      std::unique_lock<std::mutex> lk(mu_);
+      while (static_cast<int>(threads_.size()) < extra)
+        threads_.emplace_back([this] { worker_main(); });
+      job_ = &fn;
+      wanted_ = extra;
+      claimed_ = 0;
+      pending_ = extra;
+      ++epoch_;
+    }
+    cv_work_.notify_all();
+    fn();  // the caller is a participant too
+    std::unique_lock<std::mutex> lk(mu_);
+    cv_done_.wait(lk, [this] { return pending_ == 0; });
+  }
+
+ private:
+  void worker_main() {
+    uint64_t seen = 0;
+    std::unique_lock<std::mutex> lk(mu_);
+    for (;;) {
+      cv_work_.wait(lk, [&] { return stop_ || epoch_ != seen; });
+      if (stop_) return;
+      seen = epoch_;
+      if (claimed_ >= wanted_) continue;  // over quota for this epoch
+      ++claimed_;
+      const std::function<void()>* fn = job_;
+      lk.unlock();
+      (*fn)();
+      lk.lock();
+      if (--pending_ == 0) cv_done_.notify_all();
+    }
+  }
+
+  std::mutex run_mu_;  // serialises whole run() calls
+  std::mutex mu_;
+  std::condition_variable cv_work_, cv_done_;
+  std::vector<std::thread> threads_;
+  const std::function<void()>* job_ = nullptr;
+  uint64_t epoch_ = 0;
+  int wanted_ = 0, claimed_ = 0, pending_ = 0;
+  bool stop_ = false;
+};
+
+// REPORTER_TPU_PREP_THREADS fallback when the caller passes n_threads<=0
+// (the ctypes binding passes its own resolved count; other callers get
+// the same env contract without a Python layer in between).
+int env_prep_threads() {
+  const char* v = std::getenv("REPORTER_TPU_PREP_THREADS");
+  if (v != nullptr && v[0] != '\0') {
+    const long n = std::strtol(v, nullptr, 10);
+    if (n > 0) return static_cast<int>(n);
+  }
+  const unsigned hw = std::thread::hardware_concurrency();
+  return hw ? static_cast<int>(hw) : 1;
+}
+
+// ---- route-pair memo ----------------------------------------------------
+// The (edge_from, edge_to) node-route kernel — distance and travel time
+// from edge_from's end node to edge_to's start node along the
+// shortest-DISTANCE path — is bound-independent once found: a bounded
+// Dijkstra settles exact shortest distances for every node it returns
+// (relaxation never inserts past the bound), so a finite cached value is
+// reusable at ANY query bound, and an unreachable verdict is reusable at
+// any bound its search already covered. Offsets, turn penalties and the
+// time-admissibility check are reapplied per query — mirroring the
+// Python RouteCache pair level (graph/route.py), whose key deliberately
+// carries no dt. Consecutive trace steps and co-located traces repeat
+// the same candidate-edge pairs constantly; a memo hit skips the stripe
+// lock and the whole Dijkstra-map probe.
+struct PairVal {
+  float d;      // node distance m; >= kUnreachable means "not reachable"
+  float t;      // node travel seconds (valid when d finite)
+  float bound;  // search bound the verdict is proven to (unreachable case)
+};
+
+// In-call memo, one per worker thread per native call: keyed by the
+// FROM edge, holding that edge's known (to-edge -> kernel) pairs as two
+// small parallel vectors. A route block row shares one ea across all K
+// targets, so the row does ONE hash probe and then K linear scans of a
+// vector that is 1-2 cache lines hot — measured faster than a flat
+// pair-keyed table, whose per-(i,j) probes each took a cold cache miss
+// on a table that grows with the whole chunk's pair set.
+struct EaMemo {
+  std::vector<int32_t> ebs;
+  std::vector<PairVal> vals;
+
+  int find(int32_t eb) const {
+    const size_t n = ebs.size();
+    for (size_t i = 0; i < n; ++i)
+      if (ebs[i] == eb) return static_cast<int>(i);
+    return -1;
+  }
+
+  void push(int32_t eb, const PairVal& v) {
+    ebs.push_back(eb);
+    vals.push_back(v);
+  }
+};
+
+struct PairLocal {
+  // node-based map: EaMemo references stay valid across other inserts
+  std::unordered_map<int32_t, EaMemo> by_ea;
+  int64_t n_pairs = 0;
+
+  EaMemo& row(int32_t ea) { return by_ea[ea]; }
+
+  void clear() {
+    by_ea.clear();
+    n_pairs = 0;
+  }
+};
+
+// Bounded cross-call route-pair memo, lock-striped by the FROM edge —
+// the C++ analog of the Python pair cache (REPORTER_TPU_ROUTE_MEMO
+// entries across all stripes; 0 disables). Pairs are stored as per-ea
+// rows of (eb, kernel) parallel vectors: a route block row shares one
+// ea across its K targets, so route_step batches the whole row's
+// lookups (and later its inserts) under ONE stripe lock and scans a
+// vector that is a cache line or two hot. Recency is clock/second-
+// chance per row (a `hot` flag set on lookup, no per-get list splicing
+// — the splice writes were measured as cross-thread cache-line
+// ping-pong costing more than the memo saved); eviction drops whole
+// cold rows. Hit/miss/eviction counters feed rt_route_memo_stats.
+class PairMemo {
+ public:
+  static constexpr int kStripes = 64;
+
+  // same row representation (and linear scan) as the in-call EaMemo,
+  // plus the clock bit
+  struct Row : EaMemo {
+    bool hot = false;
+  };
+
+  struct Stripe {
+    std::mutex mu;
+    std::unordered_map<int32_t, Row> rows;
+    std::vector<int32_t> ring;  // clock ring of row keys
+    size_t hand = 0;
+    int64_t pairs = 0, hits = 0, misses = 0, evictions = 0;
+  };
+
+  explicit PairMemo(int64_t capacity) {
+    cap_per_stripe_ = capacity > 0 ? (capacity + kStripes - 1) / kStripes : 0;
+  }
+
+  bool enabled() const { return cap_per_stripe_ > 0; }
+
+  int64_t capacity() const { return cap_per_stripe_ * kStripes; }
+
+  Stripe& stripe(int32_t ea) {
+    return stripes_[static_cast<uint32_t>(ea) % kStripes];
+  }
+
+  // Insert/update `n` kernels of one ea row; caller holds stripe.mu.
+  void put_row_locked(Stripe& s, int32_t ea, size_t n, const int32_t* ebs,
+                      const PairVal* vals) {
+    auto it = s.rows.find(ea);
+    if (it == s.rows.end()) {
+      it = s.rows.emplace(ea, Row{}).first;
+      s.ring.push_back(ea);
+    }
+    Row& r = it->second;
+    for (size_t i = 0; i < n; ++i) {
+      const int pos = r.find(ebs[i]);
+      if (pos >= 0) {
+        r.vals[pos] = vals[i];  // deepened verdict replaces the stale one
+      } else {
+        r.ebs.push_back(ebs[i]);
+        r.vals.push_back(vals[i]);
+        ++s.pairs;
+      }
+    }
+    r.hot = true;
+    // clock eviction: sweep the ring, demoting hot rows, dropping cold
+    // ones, until the stripe fits its share of the bound
+    while (s.pairs > cap_per_stripe_ && !s.ring.empty()) {
+      if (s.hand >= s.ring.size()) s.hand = 0;
+      const int32_t key = s.ring[s.hand];
+      auto vit = s.rows.find(key);
+      if (vit == s.rows.end()) {  // stale ring slot
+        s.ring[s.hand] = s.ring.back();
+        s.ring.pop_back();
+        continue;
+      }
+      if (vit->second.hot) {
+        vit->second.hot = false;
+        ++s.hand;
+        continue;
+      }
+      s.pairs -= static_cast<int64_t>(vit->second.ebs.size());
+      s.evictions += static_cast<int64_t>(vit->second.ebs.size());
+      s.rows.erase(vit);
+      s.ring[s.hand] = s.ring.back();
+      s.ring.pop_back();
+    }
+  }
+
+  void clear() {
+    for (auto& s : stripes_) {
+      std::lock_guard<std::mutex> lk(s.mu);
+      s.rows.clear();
+      s.ring.clear();
+      s.hand = 0;
+      s.pairs = 0;
+    }
+  }
+
+  // out[4] = {hits, misses, size, evictions}
+  void stats(int64_t out[4]) {
+    out[0] = out[1] = out[2] = out[3] = 0;
+    for (auto& s : stripes_) {
+      std::lock_guard<std::mutex> lk(s.mu);
+      out[0] += s.hits;
+      out[1] += s.misses;
+      out[2] += s.pairs;
+      out[3] += s.evictions;
+    }
+  }
+
+ private:
+  std::array<Stripe, kStripes> stripes_;
+  int64_t cap_per_stripe_ = 0;
+};
+
+// per-worker route scratch: the local pair memo plus per-row work lists
+// (reused so no per-row allocation). rt_prepare_batch keeps one of
+// these per worker SLOT on the graph handle, persistent across calls —
+// the pipeline preps in 128-trace chunks, and rebuilding a ~30k-pair
+// local memo (plus its allocations and the re-consults of the shared
+// store) four times per 512 traces measured as the whole memo win given
+// back. The slot's memo is cleared when it outgrows the configured
+// bound, or every call when the shared memo is disabled (env 0 must
+// mean no cross-call memoisation at all).
+struct RouteScratch {
+  PairLocal local;
+  std::vector<int32_t> miss;      // js awaiting the shared memo / search
+  std::vector<int32_t> hit_js;    // shared-memo hits, emitted post-lock
+  std::vector<PairVal> hit_vals;
+  std::vector<int32_t> put_ebs;   // freshly computed kernels to publish
+  std::vector<PairVal> put_vals;
+};
 
 struct Graph {
   int64_t n_nodes = 0;
@@ -146,6 +419,29 @@ struct Graph {
     return route_stripes[static_cast<uint32_t>(src) % kStripes];
   }
 
+  // cross-call (edge_from, edge_to) route-pair memo + the persistent
+  // prep worker pool (both per handle; see the class docs above)
+  PairMemo pair_memo{[] {
+    const char* v = std::getenv("REPORTER_TPU_ROUTE_MEMO");
+    if (v != nullptr && v[0] != '\0') {
+      const long long n = std::strtoll(v, nullptr, 10);
+      return static_cast<int64_t>(n < 0 ? 0 : n);
+    }
+    return static_cast<int64_t>(1) << 18;  // ~260k pairs
+  }()};
+  WorkerPool pool;
+
+  // rt_prepare_batch state, serialised by prep_mu (the matcher preps
+  // from one thread; concurrent direct callers queue): per-worker-slot
+  // route scratches (see RouteScratch) and the whole-batch candidate
+  // staging buffers, both reused across calls so a 128-trace pipeline
+  // chunk doesn't pay fresh multi-MB allocations per call.
+  std::mutex prep_mu;
+  std::vector<std::unique_ptr<RouteScratch>> prep_slots;
+  std::vector<double> sc_px, sc_py;
+  std::vector<int32_t> sc_edge;
+  std::vector<float> sc_dist, sc_off;
+
   static int64_t cell_key(int64_t i, int64_t j) {
     // shift on the unsigned representation: << on negative values is UB
     return static_cast<int64_t>((static_cast<uint64_t>(i) << 32) ^
@@ -200,11 +496,19 @@ struct Graph {
   // entries. Caller must hold stripe_for(src).mu for the whole call AND
   // for as long as it reads the returned map (an extension to a larger
   // bound move-assigns the mapped value, invalidating concurrent reads).
-  const FlatMap& dists_from(int32_t src, float bound) {
+  // ``covered`` (optional) reports the bound the returned map actually
+  // covers — a cached entry may have been searched at a larger bound
+  // than requested, which makes its absence-verdicts proven further out
+  // (the pair memo records that so future queries reuse them).
+  const FlatMap& dists_from(int32_t src, float bound,
+                            float* covered = nullptr) {
     auto& route_cache = stripe_for(src).map;
     auto it = route_cache.find(src);
-    if (it != route_cache.end() && it->second.first >= bound)
+    if (it != route_cache.end() && it->second.first >= bound) {
+      if (covered) *covered = it->second.first;
       return it->second.second;
+    }
+    if (covered) *covered = bound;
     // pre-size from the entry being extended (if any): a bound extension
     // revisits at least as many nodes as the cached search found
     size_t cap = 16;
@@ -252,15 +556,21 @@ struct Cand {
 };
 
 // per-thread scratch for candidate search (seen is n_edges bytes; reused
-// across points so the clear is O(|touched|), not O(E)). Consecutive
-// probe points are metres apart while cells are ~75 m, so the 3x3 cell
-// neighborhood usually repeats point-to-point: the deduped neighborhood
-// edge list is cached and reused until the centre cell (or reach)
-// changes, skipping the 9 hash lookups + dedup on most points.
+// across points so the clear is O(|touched|), not O(E)). The deduped
+// neighborhood is cached until the centre cell (or reach) changes AND
+// gathered into compact SoA columns, so the per-point distance loop runs
+// contiguous and branch-light (auto-vectorisable) instead of chasing
+// per-edge indices through the graph tables. Points arrive sorted into
+// grid-cell order (candidates_batch below), so the neighborhood rebuild
+// amortises over every point of a cell, not just consecutive ones.
 struct CandScratch {
   std::vector<Cand> cands;
   std::vector<char> seen;
   std::vector<int32_t> nbr_edges;  // deduped; doubles as the seen-clear list
+  // gathered neighborhood columns (one entry per nbr edge)
+  std::vector<double> nbr_ax, nbr_ay, nbr_dx, nbr_dy, nbr_len2;
+  std::vector<float> nbr_len;
+  std::vector<double> sc_f, sc_d2;  // per-point projection scratch
   int64_t nbr_ci = INT64_MIN, nbr_cj = INT64_MIN, nbr_reach = -1;
   explicit CandScratch(int64_t n_edges) : seen(n_edges, 0) {}
 };
@@ -294,23 +604,53 @@ void candidates_for_point(const Graph* g, double x, double y, int32_t k,
         }
       }
     }
+    // gather the neighborhood's SoA columns once; every point in this
+    // cell then runs a contiguous distance loop over them
+    const size_t m = s.nbr_edges.size();
+    s.nbr_ax.resize(m);
+    s.nbr_ay.resize(m);
+    s.nbr_dx.resize(m);
+    s.nbr_dy.resize(m);
+    s.nbr_len2.resize(m);
+    s.nbr_len.resize(m);
+    for (size_t i = 0; i < m; ++i) {
+      const int32_t e = s.nbr_edges[i];
+      s.nbr_ax[i] = g->e_ax[e];
+      s.nbr_ay[i] = g->e_ay[e];
+      s.nbr_dx[i] = g->e_dx[e];
+      s.nbr_dy[i] = g->e_dy[e];
+      s.nbr_len2[i] = g->e_len2[e];
+      s.nbr_len[i] = g->edge_len[e];
+    }
   }
-  for (int32_t e : s.nbr_edges) {
-    const double ax = g->e_ax[e];
-    const double ay = g->e_ay[e];
-    const double dx = g->e_dx[e], dy = g->e_dy[e];
-    double f = ((x - ax) * dx + (y - ay) * dy) / g->e_len2[e];
+  const size_t m = s.nbr_edges.size();
+  s.sc_f.resize(m);
+  s.sc_d2.resize(m);
+  // pass 1: branch-free projection + squared distance over contiguous
+  // columns (the compiler vectorises this; same double math as the
+  // numpy path, so tie-order parity holds)
+  for (size_t i = 0; i < m; ++i) {
+    double f = ((x - s.nbr_ax[i]) * s.nbr_dx[i] +
+                (y - s.nbr_ay[i]) * s.nbr_dy[i]) / s.nbr_len2[i];
     f = std::min(1.0, std::max(0.0, f));
-    const double qx = ax + f * dx, qy = ay + f * dy;
-    // cheap squared-distance prefilter (with ulp slack) so the exact
-    // but slow hypot — which must match numpy's np.hypot for
-    // tie-order parity (graph/spatial.py:125) — only runs for edges
-    // actually near the point
-    const double ex = x - qx, ey = y - qy;
-    if (ex * ex + ey * ey > radius * radius * 1.0000001) continue;
-    const double d = std::hypot(ex, ey);
+    const double ex = x - (s.nbr_ax[i] + f * s.nbr_dx[i]);
+    const double ey = y - (s.nbr_ay[i] + f * s.nbr_dy[i]);
+    s.sc_f[i] = f;
+    s.sc_d2[i] = ex * ex + ey * ey;
+  }
+  // pass 2: the exact but slow hypot — which must match numpy's np.hypot
+  // for tie-order parity (graph/spatial.py:125) — only for edges the
+  // squared-distance prefilter (with ulp slack) kept
+  const double lim = radius * radius * 1.0000001;
+  for (size_t i = 0; i < m; ++i) {
+    if (s.sc_d2[i] > lim) continue;
+    const double f = s.sc_f[i];
+    const double qx = s.nbr_ax[i] + f * s.nbr_dx[i];
+    const double qy = s.nbr_ay[i] + f * s.nbr_dy[i];
+    const double d = std::hypot(x - qx, y - qy);
     if (d <= radius) {
-      s.cands.push_back({d, e, static_cast<float>(f * g->edge_len[e]),
+      s.cands.push_back({d, s.nbr_edges[i],
+                         static_cast<float>(f * s.nbr_len[i]),
                          static_cast<float>(qx), static_cast<float>(qy)});
     }
   }
@@ -340,6 +680,57 @@ void candidates_for_point(const Graph* g, double x, double y, int32_t k,
   }
 }
 
+// Batch-sorted candidate sweep over points [lo, hi): sort the span into
+// grid-cell order, sweep it (a cell's neighborhood is built +
+// SoA-gathered once per run of points that landed in it — CandScratch's
+// cache), and scatter each point's (K,) result rows back by original
+// index — output is identical to a per-point scan, position for
+// position, regardless of how callers span the points. ``order`` is
+// caller scratch, reused across spans. This is THE candidate kernel:
+// rt_candidates chunks flat queries through it, and rt_prepare_batch's
+// span workers run it per trace span before routing those traces.
+// Spans stay cache-sized and small: a serial whole-batch sort measured
+// as long as the sweep it was meant to help, and under the device lanes
+// a coarse span turns into a straggler tail on a descheduled worker.
+constexpr int64_t kCandChunk = 1024;
+
+void sweep_span(const Graph* g, int64_t lo, int64_t hi, const double* px,
+                const double* py, int32_t k, double radius,
+                CandScratch& scratch,
+                std::vector<std::pair<int64_t, int64_t>>& order,
+                int32_t* out_edge, float* out_dist, float* out_off,
+                float* out_px, float* out_py) {
+  const double cell = g->cell;
+  order.clear();
+  for (int64_t p = lo; p < hi; ++p) {
+    const int64_t ci = static_cast<int64_t>(std::floor(px[p] / cell));
+    const int64_t cj = static_cast<int64_t>(std::floor(py[p] / cell));
+    order.emplace_back(Graph::cell_key(ci, cj), p);
+  }
+  std::sort(order.begin(), order.end());
+  for (const auto& kp : order) {
+    const int64_t idx = kp.second;
+    const int64_t o = idx * k;
+    candidates_for_point(g, px[idx], py[idx], k, radius, scratch,
+                         out_edge + o, out_dist + o, out_off + o,
+                         out_px ? out_px + o : nullptr,
+                         out_py ? out_py + o : nullptr);
+  }
+}
+
+void candidates_batch(const Graph* g, int64_t n_pts, const double* px,
+                      const double* py, int32_t k, double radius,
+                      int32_t* out_edge, float* out_dist, float* out_off,
+                      float* out_px, float* out_py) {
+  CandScratch scratch(g->n_edges);
+  std::vector<std::pair<int64_t, int64_t>> order;
+  order.reserve(static_cast<size_t>(std::min(n_pts, kCandChunk)));
+  for (int64_t lo = 0; lo < n_pts; lo += kCandChunk)
+    sweep_span(g, lo, std::min(lo + kCandChunk, n_pts), px, py, k, radius,
+               scratch, order, out_edge, out_dist, out_off, out_px,
+               out_py);
+}
+
 // One (K, K) route-distance block between consecutive candidate rows.
 // Admissibility mirrors Meili's two bounds (reference: Dockerfile:14-17):
 // distance — route fits within max(min_bound, factor * gc);
@@ -348,6 +739,12 @@ void candidates_for_point(const Graph* g, double x, double y, int32_t k,
 //            have_dt && time_factor > 0 && dt > 0).
 // turn_penalty_factor adds meters for the heading change between the two
 // candidate edges: factor * 0.5 * (1 - cos(theta)).
+//
+// Each general (ea, eb) pair consults the in-call table, then the shared
+// cross-call LRU; only rows with memo misses take the stripe lock and
+// probe the Dijkstra map. Admissibility is reapplied per query from the
+// cached node kernel, so a memo hit is bit-identical to a recompute.
+//
 // Returns the largest finite distance written (0 when none): the wire-
 // dtype decision needs the batch max, and computing it here — while the
 // values are in registers — replaces a second cold pass over the 16 MB
@@ -357,7 +754,7 @@ float route_step(Graph* g, const int32_t* ea_row, const float* oa_row,
                  float gc_t, double dt_t, bool have_dt, double factor,
                  double min_bound, double backward_tol, double time_factor,
                  double min_time_bound, double turn_penalty_factor,
-                 float* out) {
+                 RouteScratch& rs, float* out) {
   const float bound = static_cast<float>(
       std::max(min_bound, factor * static_cast<double>(gc_t)));
   // min_time_bound floors the cap the way min_bound floors the distance
@@ -379,12 +776,40 @@ float route_step(Graph* g, const int32_t* ea_row, const float* oa_row,
     const float oa = oa_row[i];
     const float remaining = g->edge_len[ea] - oa;
     const int32_t src = g->edge_end[ea];
-    // one bounded search from ea's end node covers every target j.
-    // The stripe lock is held across compute AND the row fill below:
-    // a concurrent bound-extension on the same src move-assigns the
-    // cached map, so reads must stay inside the critical section.
-    std::lock_guard<std::mutex> lock(g->stripe_for(src).mu);
-    const auto& dist = g->dists_from(src, bound);
+
+    // one admissibility emitter shared by the memo-hit and recompute
+    // paths so the two cannot drift: dn/tn are the node kernel
+    // (dn >= kUnreachable: not reachable within a bound >= bound - via)
+    auto emit = [&](int32_t j, int32_t eb, float ob, float via, float dn,
+                    float tn) {
+      // reachable only if the whole route fits inside the bound, matching
+      // the python fallback's max_dist semantics (graph/route.py)
+      if (dn >= kUnreachable || via + dn > bound) {
+        row[j] = kUnreachable;
+        return;
+      }
+      if (time_cap >= 0) {
+        const float secs = g->edge_secs(ea, remaining) +
+                           g->edge_secs(eb, ob) + tn;
+        if (secs > time_cap) {
+          row[j] = kUnreachable;
+          return;
+        }
+      }
+      float d = via + dn;
+      if (turn_penalty_factor > 0) {
+        const float cos_th =
+            g->head_x[ea] * g->head_x[eb] + g->head_y[ea] * g->head_y[eb];
+        d += static_cast<float>(turn_penalty_factor) * 0.5f * (1.0f - cos_th);
+      }
+      row[j] = d;
+      if (d > mx) mx = d;
+    };
+
+    // ONE in-call memo probe per row: every target j of this row shares
+    // ea, so the row's known kernels live in one small hot vector
+    EaMemo& em = rs.local.row(ea);
+    rs.miss.clear();
     for (int32_t j = 0; j < K; ++j) {
       const int32_t eb = eb_row[j];
       if (eb == kPadEdge) {
@@ -412,29 +837,102 @@ float route_step(Graph* g, const int32_t* ea_row, const float* oa_row,
         row[j] = kUnreachable;
         continue;
       }
-      const Graph::DistTime* it = dist.find(g->edge_start[eb]);
-      // reachable only if the whole route fits inside the bound, matching
-      // the python fallback's max_dist semantics (graph/route.py)
-      if (it == nullptr || via + it->d > bound) {
-        row[j] = kUnreachable;
+      // a finite kernel is exact at any bound; an unreachable verdict
+      // only proves depths its search covered (bound - via needed here)
+      const int pos = em.find(eb);
+      if (pos >= 0 && (em.vals[pos].d < kUnreachable ||
+                       em.vals[pos].bound >= bound - via)) {
+        emit(j, eb, ob, via, em.vals[pos].d, em.vals[pos].t);
         continue;
       }
-      if (time_cap >= 0) {
-        const float secs = g->edge_secs(ea, remaining) +
-                           g->edge_secs(eb, ob) + it->t;
-        if (secs > time_cap) {
-          row[j] = kUnreachable;
-          continue;
+      rs.miss.push_back(j);
+    }
+    if (rs.miss.empty()) continue;
+
+    // shared memo consult for the whole row under ONE stripe(ea) lock;
+    // hits are copied out and emitted after the lock drops
+    if (g->pair_memo.enabled()) {
+      rs.hit_js.clear();
+      rs.hit_vals.clear();
+      size_t w = 0;
+      {
+        auto& sp = g->pair_memo.stripe(ea);
+        std::lock_guard<std::mutex> lk(sp.mu);
+        auto it = sp.rows.find(ea);
+        PairMemo::Row* rp = it != sp.rows.end() ? &it->second : nullptr;
+        if (rp != nullptr) rp->hot = true;
+        for (const int32_t j : rs.miss) {
+          const int32_t eb = eb_row[j];
+          const float via = remaining + ob_row[j];
+          const int pos = rp != nullptr ? rp->find(eb) : -1;
+          if (pos >= 0 && (rp->vals[pos].d < kUnreachable ||
+                           rp->vals[pos].bound >= bound - via)) {
+            ++sp.hits;
+            rs.hit_js.push_back(j);
+            rs.hit_vals.push_back(rp->vals[pos]);
+          } else {
+            ++sp.misses;
+            rs.miss[w++] = j;  // compact: still needs the search
+          }
         }
       }
-      float d = via + it->d;
-      if (turn_penalty_factor > 0) {
-        const float cos_th =
-            g->head_x[ea] * g->head_x[eb] + g->head_y[ea] * g->head_y[eb];
-        d += static_cast<float>(turn_penalty_factor) * 0.5f * (1.0f - cos_th);
+      rs.miss.resize(w);
+      for (size_t i = 0; i < rs.hit_js.size(); ++i) {
+        const int32_t j = rs.hit_js[i];
+        const int32_t eb = eb_row[j];
+        const PairVal& pv = rs.hit_vals[i];
+        const int lp = em.find(eb);
+        if (lp >= 0) {
+          em.vals[lp] = pv;
+        } else {
+          em.push(eb, pv);
+          ++rs.local.n_pairs;
+        }
+        emit(j, eb, ob_row[j], remaining + ob_row[j], pv.d, pv.t);
       }
-      row[j] = d;
-      if (d > mx) mx = d;
+      if (rs.miss.empty()) continue;
+    }
+
+    rs.put_ebs.clear();
+    rs.put_vals.clear();
+    {
+      // one bounded search from ea's end node covers every missed j.
+      // The stripe lock is held across compute AND the fills below: a
+      // concurrent bound-extension on the same src move-assigns the
+      // cached map, so reads must stay inside the critical section.
+      std::lock_guard<std::mutex> lock(g->stripe_for(src).mu);
+      float covered = bound;
+      const auto& dist = g->dists_from(src, bound, &covered);
+      for (const int32_t j : rs.miss) {
+        const int32_t eb = eb_row[j];
+        const float ob = ob_row[j];
+        const float via = remaining + ob;
+        const Graph::DistTime* it = dist.find(g->edge_start[eb]);
+        // every map entry is a settled exact shortest distance (the
+        // relaxation never inserts past the search bound), so a find
+        // miss proves dist(dst) > covered and a hit is final — both
+        // cacheable
+        const PairVal pv = it == nullptr
+                               ? PairVal{kUnreachable, 0.0f, covered}
+                               : PairVal{it->d, it->t, covered};
+        const int pos = em.find(eb);
+        if (pos >= 0) {
+          em.vals[pos] = pv;  // deepen a stale unreachable verdict
+        } else {
+          em.push(eb, pv);
+          ++rs.local.n_pairs;
+        }
+        rs.put_ebs.push_back(eb);
+        rs.put_vals.push_back(pv);
+        emit(j, eb, ob, via, pv.d, pv.t);
+      }
+    }
+    // publish the freshly computed kernels in one batched insert
+    if (g->pair_memo.enabled() && !rs.put_ebs.empty()) {
+      auto& sp = g->pair_memo.stripe(ea);
+      std::lock_guard<std::mutex> lk(sp.mu);
+      g->pair_memo.put_row_locked(sp, ea, rs.put_ebs.size(),
+                                  rs.put_ebs.data(), rs.put_vals.data());
     }
   }
   return mx;
@@ -466,7 +964,7 @@ extern "C" {
 // numpy path loudly instead of calling through a stale signature. BUMP
 // THIS on ANY change to the signatures below, in the same commit as the
 // Python-side constant.
-int32_t rt_abi_version(void) { return 10; }
+int32_t rt_abi_version(void) { return 11; }
 
 void* rt_graph_create(int64_t n_nodes, int64_t n_edges,
                       const double* node_x, const double* node_y,
@@ -494,6 +992,14 @@ void rt_cache_clear(void* handle) {
     std::lock_guard<std::mutex> lock(s.mu);
     s.map.clear();
   }
+  g->pair_memo.clear();
+  std::lock_guard<std::mutex> lock(g->prep_mu);
+  for (auto& slot : g->prep_slots) slot->local.clear();
+}
+
+// {hits, misses, size, evictions} of the cross-call route-pair memo
+void rt_route_memo_stats(void* handle, int64_t* out4) {
+  static_cast<Graph*>(handle)->pair_memo.stats(out4);
 }
 
 int64_t rt_cache_size(void* handle) {
@@ -513,12 +1019,8 @@ void rt_candidates(void* handle, int64_t n_points, const double* px,
                    int32_t* out_edge, float* out_dist, float* out_off,
                    float* out_px, float* out_py) {
   auto* g = static_cast<Graph*>(handle);
-  CandScratch scratch(g->n_edges);
-  for (int64_t t = 0; t < n_points; ++t) {
-    const int64_t o = t * k;
-    candidates_for_point(g, px[t], py[t], k, radius, scratch, out_edge + o,
-                         out_dist + o, out_off + o, out_px + o, out_py + o);
-  }
+  candidates_batch(g, n_points, px, py, k, radius, out_edge, out_dist,
+                   out_off, out_px, out_py);
 }
 
 // (T-1, K, K) route-distance tensor between consecutive candidate sets.
@@ -540,11 +1042,12 @@ void rt_route_matrices(void* handle, int64_t T, int32_t K,
                        double time_factor, double min_time_bound,
                        double turn_penalty_factor, float* out) {
   auto* g = static_cast<Graph*>(handle);
+  RouteScratch rs;
   for (int64_t t = 0; t + 1 < T; ++t) {
     route_step(g, edge_ids + t * K, offsets + t * K, edge_ids + (t + 1) * K,
                offsets + (t + 1) * K, K, gc[t], dt ? dt[t] : 0.0,
                dt != nullptr, factor, min_bound, backward_tol, time_factor,
-               min_time_bound, turn_penalty_factor,
+               min_time_bound, turn_penalty_factor, rs,
                out + t * static_cast<int64_t>(K) * K);
   }
 }
@@ -574,9 +1077,19 @@ void rt_route_matrices(void* handle, int64_t T, int32_t K,
 // — so the caller may hand in uninitialised (np.empty) tensors; only
 // filler rows beyond n_traces (mesh/pow2 batch padding) remain the
 // caller's to fill. out_dwell gets the trailing jitter dwell
-// (batchpad.py:109-123 semantics). n_threads <= 0 picks
-// hardware_concurrency; traces fan out across threads (the route cache
-// is lock-striped; ctypes releases the GIL for the whole call).
+// (batchpad.py:109-123 semantics). n_threads <= 0 falls back to
+// REPORTER_TPU_PREP_THREADS, then hardware_concurrency; work fans out
+// over the handle's persistent WorkerPool in two phases — the batch-
+// sorted candidate sweep (cell-granular) then the per-trace
+// select/route phase (trace-granular) — with deterministic output
+// either way (the route cache is lock-striped and the pair memo stores
+// exact kernels; ctypes releases the GIL for the whole call).
+// ``out_phase_ns`` (nullable, 3 slots) reports the phase split:
+// {candidates, select_pack, routes} in nanoseconds, each summed across
+// worker threads. The ctypes side folds these into utils.metrics so the
+// BENCH artifact can attribute prep time without a profiler;
+// REPORTER_TPU_PREP_TIMINGS=1 additionally prints one stderr line per
+// call.
 void rt_prepare_batch(void* handle, int64_t n_traces, const int64_t* pt_off,
                       const double* lat, const double* lon,
                       const double* times, double lat0, double lon0,
@@ -590,14 +1103,18 @@ void rt_prepare_batch(void* handle, int64_t n_traces, const int64_t* pt_off,
                       float* out_route, float* out_gc, int32_t* out_case,
                       int32_t* out_kept, int32_t* out_num_kept,
                       float* out_dwell, uint8_t* out_has_cands,
-                      float* out_max_finite) {
+                      float* out_max_finite, int64_t* out_phase_ns) {
   auto* g = static_cast<Graph*>(handle);
+  // one prepare call at a time per handle: the per-slot scratches and
+  // candidate staging buffers below are reused across calls
+  std::lock_guard<std::mutex> prep_lock(g->prep_mu);
   const double coslat0 = std::cos(lat0 * kRadPerDeg);
   const int64_t TK = static_cast<int64_t>(T) * K;
   // route/gc rows are T per trace (not T-1): the final row is a dead
   // step the caller pre-fills, so the (B, T, K, K) tensor shards along
   // the seq mesh axis with no host-side pad copy (parallel/sharded.py)
   const int64_t TKK = static_cast<int64_t>(T) * K * K;
+  const int64_t n_pts = n_traces > 0 ? pt_off[n_traces] : 0;
 
   // running max of every finite distance written (candidate dists, gc,
   // reachable route entries) — the wire-dtype decision (f16 iff the max
@@ -611,10 +1128,6 @@ void rt_prepare_batch(void* handle, int64_t n_traces, const int64_t* pt_off,
     }
   };
 
-  // env-gated phase attribution (REPORTER_TPU_PREP_TIMINGS=1): ns per
-  // phase summed across worker threads, one stderr line per call — the
-  // only way to see inside the ctypes boundary without a profiler in
-  // the image. Off: one predictable branch per phase per trace.
   static const bool timings = [] {
     const char* v = std::getenv("REPORTER_TPU_PREP_TIMINGS");
     return v != nullptr && v[0] != '\0' && v[0] != '0';
@@ -622,14 +1135,39 @@ void rt_prepare_batch(void* handle, int64_t n_traces, const int64_t* pt_off,
   using clk = std::chrono::steady_clock;
   std::atomic<int64_t> ns_cand{0}, ns_select{0}, ns_route{0};
 
-  auto prepare_one = [&](int64_t b, CandScratch& scratch,
-                         std::vector<int32_t>& edge_raw,
-                         std::vector<float>& dist_raw,
-                         std::vector<float>& off_raw,
+  int workers = n_threads > 0 ? n_threads : env_prep_threads();
+  workers = std::max(1, std::min<int>(
+                            workers, static_cast<int>(
+                                         std::max<int64_t>(n_traces, 1))));
+
+  // Flat (n_pts, K) candidate staging buffers, persistent on the handle
+  // — a 128-trace pipeline chunk must not pay multi-MB allocations per
+  // call. Every trace reads its rows out of them by point index, so
+  // per-trace copies of the raw candidate rows are gone.
+  g->sc_px.resize(n_pts);
+  g->sc_py.resize(n_pts);
+  double* px = g->sc_px.data();
+  double* py = g->sc_py.data();
+  for (int64_t p = 0; p < n_pts; ++p) {
+    px[p] = (lon[p] - lon0) * kMetersPerDeg * coslat0;
+    py[p] = (lat[p] - lat0) * kMetersPerDeg;
+  }
+  g->sc_edge.resize(n_pts * K);
+  g->sc_dist.resize(n_pts * K);
+  g->sc_off.resize(n_pts * K);
+  int32_t* edge_all = g->sc_edge.data();
+  float* dist_all = g->sc_dist.data();
+  float* off_all = g->sc_off.data();
+
+  // ---- per-trace selection, packing and route matrices -----------------
+  auto prepare_one = [&](int64_t b, RouteScratch& rscratch,
                          std::vector<int32_t>& kept) {
     float local_max = 0.0f;
     const int64_t p0 = pt_off[b], p1 = pt_off[b + 1];
     const int64_t n_raw = p1 - p0;
+    const int32_t* edge_raw = edge_all + p0 * K;
+    const float* dist_raw = dist_all + p0 * K;
+    const float* off_raw = off_all + p0 * K;
     int32_t* edge_b = out_edge + b * TK;
     float* dist_b = out_dist + b * TK;
     float* off_b = out_off + b * TK;
@@ -667,23 +1205,7 @@ void rt_prepare_batch(void* handle, int64_t n_traces, const int64_t* pt_off,
     }
 
     clk::time_point tp;
-    if (timings) tp = clk::now();
-    // candidates for every raw point (projection inline)
-    edge_raw.resize(n_raw * K);
-    dist_raw.resize(n_raw * K);
-    off_raw.resize(n_raw * K);
-    for (int64_t p = 0; p < n_raw; ++p) {
-      const double x = (lon[p0 + p] - lon0) * kMetersPerDeg * coslat0;
-      const double y = (lat[p0 + p] - lat0) * kMetersPerDeg;
-      candidates_for_point(g, x, y, K, search_radius, scratch,
-                           edge_raw.data() + p * K, dist_raw.data() + p * K,
-                           off_raw.data() + p * K, nullptr, nullptr);
-    }
-    if (timings) {
-      const auto t2 = clk::now();
-      ns_cand += (t2 - tp).count();
-      tp = t2;
-    }
+    if (timings || out_phase_ns) tp = clk::now();
 
     // kept selection: drop candidate-less points and jitter points within
     // interpolation_distance of the last kept point (batchpad._select_kept)
@@ -740,11 +1262,9 @@ void rt_prepare_batch(void* handle, int64_t n_traces, const int64_t* pt_off,
     // gather kept rows into the padded outputs; gc + case codes
     for (int32_t t = 0; t < n; ++t) {
       const int64_t p = kept[t];
-      std::memcpy(edge_b + t * K, edge_raw.data() + p * K,
-                  K * sizeof(int32_t));
-      std::memcpy(dist_b + t * K, dist_raw.data() + p * K,
-                  K * sizeof(float));
-      std::memcpy(off_b + t * K, off_raw.data() + p * K, K * sizeof(float));
+      std::memcpy(edge_b + t * K, edge_raw + p * K, K * sizeof(int32_t));
+      std::memcpy(dist_b + t * K, dist_raw + p * K, K * sizeof(float));
+      std::memcpy(off_b + t * K, off_raw + p * K, K * sizeof(float));
       for (int32_t q = 0; q < K; ++q) {
         const float d = dist_b[t * K + q];
         if (d < kUnreachable / 2 && d > local_max) local_max = d;
@@ -767,7 +1287,7 @@ void rt_prepare_batch(void* handle, int64_t n_traces, const int64_t* pt_off,
       }
     }
 
-    if (timings) {
+    if (timings || out_phase_ns) {
       const auto t2 = clk::now();
       ns_select += (t2 - tp).count();
       tp = t2;
@@ -782,58 +1302,74 @@ void rt_prepare_batch(void* handle, int64_t n_traces, const int64_t* pt_off,
           g, edge_b + t * K, off_b + t * K, edge_b + (t + 1) * K,
           off_b + (t + 1) * K, K, gc_b[t], dt_t, have_dt, factor,
           min_bound, backward_tol, time_factor, min_time_bound,
-          turn_penalty_factor, route_b + static_cast<int64_t>(t) * K * K);
+          turn_penalty_factor, rscratch,
+          route_b + static_cast<int64_t>(t) * K * K);
       if (step_max > local_max) local_max = step_max;
     }
     fill_tail(n, n - 1);
     bump_max(local_max);
-    if (timings) ns_route += (clk::now() - tp).count();
+    if (timings || out_phase_ns) ns_route += (clk::now() - tp).count();
   };
 
-  int32_t workers = n_threads > 0
-                        ? n_threads
-                        : static_cast<int32_t>(
-                              std::thread::hardware_concurrency());
-  workers = std::max(1, std::min<int32_t>(
-                            workers, static_cast<int32_t>(n_traces)));
-  if (workers == 1) {
-    CandScratch scratch(g->n_edges);
-    std::vector<int32_t> edge_raw, kept;
-    std::vector<float> dist_raw, off_raw;
-    for (int64_t b = 0; b < n_traces; ++b)
-      prepare_one(b, scratch, edge_raw, dist_raw, off_raw, kept);
-    *out_max_finite = max_finite.load();
-    if (timings)
-      std::fprintf(stderr,
-                   "[prep_timings] traces=%lld candidates=%.3fms "
-                   "select_pack=%.3fms routes=%.3fms (one thread)\n",
-                   static_cast<long long>(n_traces), ns_cand.load() / 1e6,
-                   ns_select.load() / 1e6, ns_route.load() / 1e6);
-    return;
-  }
+  // per-worker-slot route scratches, persistent across calls: the
+  // slot's local pair memo survives between pipeline chunks (cleared
+  // when it outgrows the shared memo's configured bound, or every call
+  // when REPORTER_TPU_ROUTE_MEMO=0 disables cross-call memoisation)
+  while (g->prep_slots.size() < static_cast<size_t>(workers))
+    g->prep_slots.emplace_back(new RouteScratch());
+  // Work unit: a SPAN of consecutive traces. The worker first runs the
+  // batch-sorted candidate kernel over the span's points (sort into
+  // grid-cell order, sweep with the gathered-SoA loops, scatter by
+  // index), then immediately selects/packs/routes those traces — no
+  // barrier between the candidate and route phases. The two-phase
+  // variant (whole-batch candidate pass, then traces) measured badly
+  // under the device lanes: with decode/assemble threads contending for
+  // the same cores, every barrier waited out a descheduled straggler.
+  constexpr int64_t kSpanTraces = 8;
+  const int64_t n_units = (n_traces + kSpanTraces - 1) / kSpanTraces;
+  const bool memo_on = g->pair_memo.enabled();
+  const int64_t local_cap = g->pair_memo.capacity();
+  std::atomic<int> slot{0};
   std::atomic<int64_t> next{0};
-  std::vector<std::thread> pool;
-  pool.reserve(workers);
-  for (int32_t w = 0; w < workers; ++w) {
-    pool.emplace_back([&]() {
-      CandScratch scratch(g->n_edges);
-      std::vector<int32_t> edge_raw, kept;
-      std::vector<float> dist_raw, off_raw;
-      for (;;) {
-        const int64_t b = next.fetch_add(1);
-        if (b >= n_traces) return;
-        prepare_one(b, scratch, edge_raw, dist_raw, off_raw, kept);
-      }
-    });
-  }
-  for (auto& th : pool) th.join();
+  auto span_worker = [&]() {
+    RouteScratch& rscratch = *g->prep_slots[slot.fetch_add(1)];
+    if (!memo_on || rscratch.local.n_pairs > local_cap)
+      rscratch.local.clear();
+    CandScratch cscratch(g->n_edges);
+    std::vector<std::pair<int64_t, int64_t>> order;
+    std::vector<int32_t> kept;
+    for (;;) {
+      const int64_t u = next.fetch_add(1);
+      if (u >= n_units) return;
+      const int64_t b0 = u * kSpanTraces;
+      const int64_t b1 = std::min(b0 + kSpanTraces, n_traces);
+      clk::time_point tp;
+      if (timings || out_phase_ns) tp = clk::now();
+      sweep_span(g, pt_off[b0], pt_off[b1], px, py, K, search_radius,
+                 cscratch, order, edge_all, dist_all, off_all, nullptr,
+                 nullptr);
+      if (timings || out_phase_ns)
+        ns_cand += (clk::now() - tp).count();
+      for (int64_t b = b0; b < b1; ++b) prepare_one(b, rscratch, kept);
+    }
+  };
+  g->pool.run(static_cast<int>(std::min<int64_t>(workers - 1,
+                                                 n_units - 1)),
+              span_worker);
   *out_max_finite = max_finite.load();
+  if (out_phase_ns) {
+    out_phase_ns[0] = ns_cand.load();
+    out_phase_ns[1] = ns_select.load();
+    out_phase_ns[2] = ns_route.load();
+  }
   if (timings)
     std::fprintf(stderr,
-                 "[prep_timings] traces=%lld candidates=%.3fms "
-                 "select_pack=%.3fms routes=%.3fms (thread-summed)\n",
-                 static_cast<long long>(n_traces), ns_cand.load() / 1e6,
-                 ns_select.load() / 1e6, ns_route.load() / 1e6);
+                 "[prep_timings] traces=%lld workers=%d "
+                 "candidates=%.3fms select_pack=%.3fms "
+                 "routes=%.3fms (thread-summed)\n",
+                 static_cast<long long>(n_traces), workers,
+                 ns_cand.load() / 1e6, ns_select.load() / 1e6,
+                 ns_route.load() / 1e6);
 }
 
 // f32 -> f16 (IEEE half) bulk conversion for the wire tensors

@@ -41,7 +41,8 @@ class BatchDispatcher:
     """Accumulates traces and runs ``match_many`` over the accumulated batch.
 
     ``match_many``: callable taking a list of trace dicts and returning a
-    list of match dicts (e.g. ``SegmentMatcher.match_many``).
+    list of match results (dicts, or the matcher's lazy ``MatchRuns``
+    column views — e.g. ``SegmentMatcher.match_many``).
     """
 
     def __init__(self, match_many: Callable[[Sequence[dict]], List[dict]],

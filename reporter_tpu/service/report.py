@@ -22,42 +22,81 @@ One deliberate deviation: the reference *assigns* the last segment's km to
 the stats ``length`` fields instead of accumulating
 (reporter_service.py:138,142); here lengths are summed, which is the
 evident intent of the telemetry.
+
+The emission state machine itself is **columnar**: it scans parallel
+per-segment value lists and accumulates parallel report lists — no dict
+is built per segment or per report inside the scan. :func:`report` is
+the structured-dict compatibility surface (tests, the worker's trimming
+logic); :func:`report_json` is the hot serving path, serialising the
+whole response straight from a :class:`~..matcher.matcher.MatchRuns`'s
+run columns to JSON — byte-identical to ``json.dumps`` over
+:func:`report`'s output (pinned by tests/test_report_writer.py).
 """
 from __future__ import annotations
 
+import json
 import math
-from typing import Iterable, Optional
+from typing import Iterable, List, Optional, Tuple
 
 
-class _Pending:
-    """The prior segment awaiting its successor before being reported."""
+class _Scan:
+    """Output of one pass of the emission state machine: the holdback
+    cut, the datastore reports as parallel lists, and the stats."""
 
-    __slots__ = ("segment_id", "start_time", "end_time", "length",
-                 "queue_length", "level", "internal")
-
-    def __init__(self, seg: dict, level: int):
-        self.segment_id = seg.get("segment_id")
-        self.start_time = seg.get("start_time")
-        self.end_time = seg.get("end_time")
-        self.length = seg.get("length")
-        self.queue_length = seg.get("queue_length")
-        self.level = level
-        self.internal = seg.get("internal", False)
+    __slots__ = ("last_idx", "shape_used", "r_id", "r_t0", "r_t1",
+                 "r_len", "r_queue", "r_next", "successful",
+                 "successful_km", "unreported", "unreported_km",
+                 "discontinuities", "invalid_times", "invalid_speeds",
+                 "unassociated")
 
 
-def report(match: dict, trace: dict, threshold_sec: float,
-           report_levels: Iterable[int],
-           transition_levels: Iterable[int]) -> dict:
-    """Turn a match result into datastore reports + stats."""
-    report_levels = set(report_levels)
-    transition_levels = set(transition_levels)
+_matcher_mod = None
+
+
+def _matcher():
+    """reporter_tpu.matcher.matcher, bound once (a per-call ``from``
+    import costs importlib machinery on every request)."""
+    global _matcher_mod
+    if _matcher_mod is None:
+        from ..matcher import matcher as _matcher_mod_  # noqa: F401
+        _matcher_mod = _matcher_mod_
+    return _matcher_mod
+
+
+def _segment_columns(match) -> Tuple[list, ...]:
+    """(seg_id, internal, start, end, length, queue, begin_idx, end_idx)
+    parallel lists for the scan — straight slices of a MatchRuns's run
+    columns (zero per-segment work), or one comprehension pass per field
+    over plain segment dicts (the numpy-fallback / hand-built path).
+    Absent segment ids are None (dict path) or -1 (column path); the
+    scan treats both as unassociated."""
+    if isinstance(match, _matcher().MatchRuns):
+        c, lo, hi = match.cols, match.lo, match.hi
+        return (c.seg_id[lo:hi], c.internal[lo:hi], c.start[lo:hi],
+                c.end[lo:hi], c.length[lo:hi], c.queue[lo:hi],
+                c.begin_idx[lo:hi], c.end_idx[lo:hi])
     segs = match["segments"]
-    trace_end = trace["trace"][-1]["time"]
+    return ([s.get("segment_id") for s in segs],
+            [s.get("internal", False) for s in segs],
+            [s.get("start_time") for s in segs],
+            [s.get("end_time") for s in segs],
+            [s.get("length") for s in segs],
+            [s.get("queue_length") for s in segs],
+            [s.get("begin_shape_index") for s in segs],
+            [s.get("end_shape_index") for s in segs])
+
+
+def _scan_segments(seg_id: list, internal: list, start: list, end: list,
+                   length: list, queue: list, begin_idx: list,
+                   end_idx: list, trace_end, threshold_sec: float,
+                   report_levels: set, transition_levels: set) -> _Scan:
+    """The reference's pairwise emission state machine
+    (reporter_service.py:79-179) over columnar inputs."""
+    n = len(seg_id)
 
     # ---- trailing holdback (reference: reporter_service.py:83-92) --------
-    last_idx = len(segs) - 1
-    while last_idx >= 0 and \
-            trace_end - segs[last_idx]["start_time"] < threshold_sec:
+    last_idx = n - 1
+    while last_idx >= 0 and trace_end - start[last_idx] < threshold_sec:
         last_idx -= 1
     shape_used: Optional[int] = None
     if last_idx >= 0:
@@ -71,99 +110,181 @@ def report(match: dict, trace: dict, threshold_sec: float,
         # straddling probe even when jitter-dropped points sit between
         # the runs.
         if last_idx > 0:
-            shape_used = segs[last_idx - 1]["end_shape_index"]
+            shape_used = end_idx[last_idx - 1]
         else:
-            shape_used = max(segs[0]["begin_shape_index"] - 1, 0)
+            shape_used = max(begin_idx[0] - 1, 0)
 
-    match["mode"] = "auto"
-    reports = []
-    stats = {
-        "successful": 0, "successful_km": 0.0,
-        "unreported": 0, "unreported_km": 0.0,
-        "discontinuities": 0, "invalid_times": 0, "invalid_speeds": 0,
-        "unassociated": 0,
-    }
+    out = _Scan()
+    out.last_idx = last_idx
+    out.shape_used = shape_used
+    r_id: List = []
+    r_t0: List = []
+    r_t1: List = []
+    r_len: List = []
+    r_queue: List = []
+    r_next: List = []
+    successful = unreported = 0
+    successful_km = unreported_km = 0.0
+    discontinuities = invalid_times = invalid_speeds = unassociated = 0
 
-    pending: Optional[_Pending] = None
+    # the pending segment awaiting its successor before being reported
+    have_pending = False
+    p_sid = p_start = p_end = p_len = p_queue = None
+    p_level = -1
     first = True
     for idx in range(last_idx + 1):
-        seg = segs[idx]
-        seg_id = seg.get("segment_id")
-        internal = seg.get("internal", False)
-        start_time = seg.get("start_time")
+        sid = seg_id[idx]
+        if sid is not None and sid < 0:
+            sid = None  # column sentinel for "no OSMLR id"
+        intern = internal[idx]
+        start_time = start[idx]
 
         # a partial end followed by a partial start marks a discontinuity
         # (reference: reporter_service.py:114-116)
-        if idx > 0 and start_time == -1 and segs[idx - 1]["end_time"] == -1:
-            stats["discontinuities"] += 1
+        if idx > 0 and start_time == -1 and end[idx - 1] == -1:
+            discontinuities += 1
 
-        level = (seg_id & 0x7) if seg_id is not None else -1
+        level = (sid & 0x7) if sid is not None else -1
 
         # emit the pending segment now that its successor is visible;
         # an internal successor defers emission (reference: :122-127)
-        if pending is not None and pending.segment_id is not None \
-                and pending.length is not None \
-                and pending.length > 0 and not internal:
-            if pending.level in report_levels:
-                t1 = start_time if level in transition_levels \
-                    else pending.end_time
-                entry = {
-                    "id": pending.segment_id,
-                    "t0": pending.start_time,
-                    "t1": t1,
-                    "length": pending.length,
-                    "queue_length": pending.queue_length,
-                }
-                if level in transition_levels and seg_id is not None:
-                    entry["next_id"] = seg_id
-                dt = float(entry["t1"]) - float(entry["t0"])
+        if have_pending and p_sid is not None and p_len is not None \
+                and p_len > 0 and not intern:
+            if p_level in report_levels:
+                t1 = start_time if level in transition_levels else p_end
+                dt = float(t1) - float(p_start)
                 if dt <= 0 or math.isinf(dt) or math.isnan(dt):
-                    stats["invalid_times"] += 1
-                elif (pending.length / dt) * 3.6 > 160:
-                    stats["invalid_speeds"] += 1
+                    invalid_times += 1
+                elif (p_len / dt) * 3.6 > 160:
+                    invalid_speeds += 1
                 else:
-                    reports.append(entry)
-                    stats["successful"] += 1
-                    stats["successful_km"] += round(pending.length * 0.001, 3)
+                    r_id.append(p_sid)
+                    r_t0.append(p_start)
+                    r_t1.append(t1)
+                    r_len.append(p_len)
+                    r_queue.append(p_queue)
+                    r_next.append(sid if (level in transition_levels
+                                          and sid is not None) else None)
+                    successful += 1
+                    successful_km += round(p_len * 0.001, 3)
             else:
-                stats["unreported"] += 1
-                stats["unreported_km"] += round(pending.length * 0.001, 3)
+                unreported += 1
+                unreported_km += round(p_len * 0.001, 3)
 
         # internal segments bridge: keep the pending prior
         # (reference: :144-156)
-        if internal and not first:
-            if pending is not None:
-                pending.internal = True
-        else:
-            pending = _Pending(seg, level)
+        if not (intern and not first):
+            p_sid = sid
+            p_start = start_time
+            p_end = end[idx]
+            p_len = length[idx]
+            p_queue = queue[idx]
+            p_level = level
+            have_pending = True
         first = False
 
         # service roads etc: matched edges with no OSMLR id
         # (reference: :159-162)
-        if seg_id is None and not internal:
-            stats["unassociated"] += 1
+        if sid is None and not intern:
+            unassociated += 1
 
+    out.r_id, out.r_t0, out.r_t1 = r_id, r_t0, r_t1
+    out.r_len, out.r_queue, out.r_next = r_len, r_queue, r_next
+    out.successful, out.successful_km = successful, successful_km
+    out.unreported, out.unreported_km = unreported, unreported_km
+    out.discontinuities = discontinuities
+    out.invalid_times = invalid_times
+    out.invalid_speeds = invalid_speeds
+    out.unassociated = unassociated
+    return out
+
+
+def report(match: dict, trace: dict, threshold_sec: float,
+           report_levels: Iterable[int],
+           transition_levels: Iterable[int]) -> dict:
+    """Turn a match result into datastore reports + stats (structured
+    dicts — the worker's trimming logic and tests consume these; the
+    serving path uses :func:`report_json` and never builds them)."""
+    scan = _scan_segments(
+        *_segment_columns(match), trace["trace"][-1]["time"],
+        threshold_sec, set(report_levels), set(transition_levels))
+    match["mode"] = "auto"
+    reports = [
+        {"id": i, "t0": t0, "t1": t1, "length": ln, "queue_length": q,
+         **({"next_id": nx} if nx is not None else {})}
+        for i, t0, t1, ln, q, nx in zip(scan.r_id, scan.r_t0, scan.r_t1,
+                                        scan.r_len, scan.r_queue,
+                                        scan.r_next)]
     out = {
         "stats": {
             "successful_matches": {
-                "count": stats["successful"],
-                "length": round(stats["successful_km"], 3),
+                "count": scan.successful,
+                "length": round(scan.successful_km, 3),
             },
             "unreported_matches": {
-                "count": stats["unreported"],
-                "length": round(stats["unreported_km"], 3),
+                "count": scan.unreported,
+                "length": round(scan.unreported_km, 3),
             },
             "match_errors": {
-                "discontinuities": stats["discontinuities"],
-                "invalid_speeds": stats["invalid_speeds"],
-                "invalid_times": stats["invalid_times"],
+                "discontinuities": scan.discontinuities,
+                "invalid_speeds": scan.invalid_speeds,
+                "invalid_times": scan.invalid_times,
             },
-            "unassociated_segments": stats["unassociated"],
+            "unassociated_segments": scan.unassociated,
         },
     }
     # reference quirk preserved: shape_used omitted when falsy (index 0)
-    if shape_used:
-        out["shape_used"] = shape_used
+    if scan.shape_used:
+        out["shape_used"] = scan.shape_used
     out["segment_matcher"] = match
     out["datastore"] = {"mode": "auto", "reports": reports}
     return out
+
+
+def report_json(match, trace: dict, threshold_sec: float,
+                report_levels: Iterable[int],
+                transition_levels: Iterable[int]) -> str:
+    """The whole ``/report`` response serialised straight from run
+    columns to JSON — the columnar response writer. Byte-identical to
+    ``json.dumps(report(...), separators=(",", ":"))`` (pinned by
+    tests/test_report_writer.py); a plain-dict match (numpy fallback or
+    hand-built) takes exactly that dict route."""
+    mm = _matcher()
+    if not isinstance(match, mm.MatchRuns):
+        return json.dumps(
+            report(match, trace, threshold_sec, report_levels,
+                   transition_levels), separators=(",", ":"))
+    scan = _scan_segments(
+        *_segment_columns(match), trace["trace"][-1]["time"],
+        threshold_sec, set(report_levels), set(transition_levels))
+    match["mode"] = "auto"  # same side effect as report()
+    r_t0, r_t1 = scan.r_t0, scan.r_t1
+    parts = []
+    for i in range(len(scan.r_id)):
+        # t0/t1 are columnar start/end values — always finite floats on
+        # this path, so bare repr matches json.dumps byte for byte
+        nx = scan.r_next[i]
+        parts.append(
+            f'{{"id":{scan.r_id[i]},"t0":{r_t0[i]!r},'
+            f'"t1":{r_t1[i]!r},"length":{scan.r_len[i]},'
+            f'"queue_length":{scan.r_queue[i]}'
+            + (f',"next_id":{nx}}}' if nx is not None else "}"))
+    body = (
+        '{"stats":{"successful_matches":{"count":%d,"length":%s},'
+        '"unreported_matches":{"count":%d,"length":%s},'
+        '"match_errors":{"discontinuities":%d,"invalid_speeds":%d,'
+        '"invalid_times":%d},"unassociated_segments":%d}'
+        % (scan.successful, mm._jnum(round(scan.successful_km, 3)),
+           scan.unreported, mm._jnum(round(scan.unreported_km, 3)),
+           scan.discontinuities, scan.invalid_speeds, scan.invalid_times,
+           scan.unassociated))
+    if scan.shape_used:
+        body += f',"shape_used":{scan.shape_used}'
+    # the holdback cut is over REPORTED segments only; the echoed
+    # segment_matcher carries every run, like the dict path
+    body += (',"segment_matcher":'
+             + mm.render_segments_json(match.cols, match.lo, match.hi,
+                                       "auto")
+             + ',"datastore":{"mode":"auto","reports":['
+             + ",".join(parts) + "]}}")
+    return body

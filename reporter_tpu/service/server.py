@@ -33,7 +33,7 @@ from ..core.tracebatch import points_to_columns
 from ..matcher import Configure, SegmentMatcher
 from ..utils import metrics
 from .dispatch import BatchDispatcher
-from .report import report
+from .report import report, report_json
 
 # /report is the reference's only action (reporter_service.py:26);
 # /stats is new — a metrics snapshot (counters + stage timers);
@@ -88,9 +88,11 @@ class ReporterService:
             match = self.dispatcher.submit(
                 trace, columns=(trace.get("uuid"), lat, lon, tm, acc,
                                 trace.get("match_options")))
-            data = report(match, trace, self.threshold_sec,
-                          report_levels, transition_levels)
-            return 200, json.dumps(data, separators=(",", ":"))
+            # columnar response writer: serialise the whole response
+            # straight from the match's run columns — the per-trace
+            # report/segment dicts never exist on this path
+            return 200, report_json(match, trace, self.threshold_sec,
+                                    report_levels, transition_levels)
         except Exception as e:
             return 500, json.dumps({"error": str(e)})
 

@@ -106,9 +106,14 @@ class Batch:
             self.drop()
             return None
         try:
+            sm = response.get("segment_matcher")
+            # MatchRuns exposes a lazy emptiness probe; only plain-dict
+            # matches (HTTP split deployments) pay the segments lookup
+            has_segments = sm.has_runs() if hasattr(sm, "has_runs") \
+                else bool(sm.get("segments")) if sm else False
             if "shape_used" in response:
                 trim_to = response["shape_used"]
-            elif response.get("segment_matcher", {}).get("segments"):
+            elif has_segments:
                 # segments matched but none consumed yet (the service
                 # omits a falsy shape_used — reference quirk): everything
                 # is still in-progress context, so keep it all. Trimming

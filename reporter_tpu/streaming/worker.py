@@ -235,6 +235,15 @@ def main(argv=None):
                              "flushed tile is ALSO aggregated in-process "
                              "(zero serialisation) so /histogram queries "
                              "work without a separate ingest step")
+    parser.add_argument("--datastore-max-deltas", type=int, default=None,
+                        help="automatic compaction: after each tee "
+                             "ingest, compact partitions holding more "
+                             "than N uncompacted deltas")
+    parser.add_argument("--datastore-max-delta-bytes", type=int,
+                        default=None,
+                        help="automatic compaction: after each tee "
+                             "ingest, compact partitions whose deltas "
+                             "exceed B bytes")
     parser.add_argument("--deadletter",
                         help="directory spooling tile bodies whose egress "
                              "failed (default <output>/.deadletter for "
@@ -284,8 +293,17 @@ def main(argv=None):
     if args.datastore:
         from ..datastore import LocalDatastore
         datastore = LocalDatastore(args.datastore)
-        tee = lambda _tile, segments: \
-            datastore.ingest_segments(segments)  # noqa: E731
+        max_deltas = args.datastore_max_deltas
+        max_bytes = args.datastore_max_delta_bytes
+
+        def tee(_tile, segments,
+                _ds=datastore, _n=max_deltas, _b=max_bytes):
+            # automatic compaction policy rides the ingest: only the
+            # partitions THIS flush touched are pressure-checked, so a
+            # city-scale store never pays a full-store sweep per flush
+            # (datastore/store.py ingest)
+            return _ds.ingest_segments(segments, max_deltas=_n,
+                                       max_delta_bytes=_b)
 
     worker = StreamWorker(
         Formatter.from_config(args.formatter), submit,

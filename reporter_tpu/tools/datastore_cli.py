@@ -42,6 +42,12 @@ def main(argv=None):
     p_cmp.add_argument("store")
     p_cmp.add_argument("--level", type=int, default=None)
     p_cmp.add_argument("--index", type=int, default=None)
+    p_cmp.add_argument("--max-deltas", type=int, default=None,
+                       help="automatic policy: only compact partitions "
+                            "with more than N uncompacted deltas")
+    p_cmp.add_argument("--max-delta-bytes", type=int, default=None,
+                       help="automatic policy: only compact partitions "
+                            "whose uncompacted deltas exceed B bytes")
 
     p_qry = sub.add_parser("query", help="one segment's speed histogram")
     p_qry.add_argument("store")
@@ -66,7 +72,9 @@ def main(argv=None):
                             limit=args.limit)
         out["metrics"] = metrics.snapshot()["timers"]
     elif args.cmd == "compact":
-        out = ds.compact(level=args.level, index=args.index)
+        out = ds.compact(level=args.level, index=args.index,
+                         max_deltas=args.max_deltas,
+                         max_delta_bytes=args.max_delta_bytes)
     elif args.cmd == "query":
         hours = parse_hours_spec(args.hours)
         if hours is None and args.t0 is not None and args.t1 is not None:

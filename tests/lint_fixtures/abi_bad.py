@@ -4,7 +4,7 @@ import ctypes
 
 import numpy as np
 
-ABI_VERSION = 8        # ABI004: cpp returns 7
+ABI_VERSION = 8        # ABI004: cpp returns 11
 
 
 def bind(lib):

@@ -4,7 +4,7 @@
 
 extern "C" {
 
-int32_t rt_abi_version(void) { return 7; }
+int32_t rt_abi_version(void) { return 11; }
 
 void* rt_thing_create(int64_t n, const double* xs, const float* ws,
                       double scale) {

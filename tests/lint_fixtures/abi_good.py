@@ -3,7 +3,7 @@ import ctypes
 
 import numpy as np
 
-ABI_VERSION = 7
+ABI_VERSION = 11
 
 
 def bind(lib):

@@ -163,7 +163,7 @@ class TestStore:
         before = ds.query(SID)
         assert ds.stats()["segments"] == 4
         out = ds.compact()
-        assert out == {"partitions": 1, "merged_segments": 4}
+        assert out == {"partitions": 1, "merged_segments": 4, "skipped": 0}
         assert ds.stats()["segments"] == 1
         after = ds.query(SID)
         assert after == before
@@ -523,3 +523,113 @@ class TestWorkerRoundTrip:
                 ps = a["percentiles"]
                 assert ps["p25"] <= ps["p50"] <= ps["p75"] <= ps["p95"]
         assert total == got["rows"]
+
+
+class TestQueryHandleCache:
+    """PR-4 satellite: /histogram stops re-opening mmaps per request —
+    a bounded partition-handle LRU keyed by the manifest's segment list,
+    with a datastore.query.cache.* metric pair."""
+
+    def test_repeat_query_hits_cache(self, tmp_path):
+        from reporter_tpu.utils import metrics
+        ds = LocalDatastore(str(tmp_path))
+        ds.ingest_segments(_segs(5))
+        metrics.default.reset()
+        want = ds.query(SID)
+        assert ds.query(SID) == want and ds.query(SID) == want
+        c = metrics.snapshot()["counters"]
+        assert c.get("datastore.query.cache.misses") == 1
+        assert c.get("datastore.query.cache.hits") == 2
+
+    def test_append_invalidates_handles(self, tmp_path):
+        ds = LocalDatastore(str(tmp_path))
+        ds.ingest_segments(_segs(5))
+        assert ds.query(SID)["count"] == 5
+        ds.ingest_segments(_segs(5))  # new manifest -> new cache key
+        assert ds.query(SID)["count"] == 10
+
+    def test_compaction_invalidates_handles(self, tmp_path):
+        ds = LocalDatastore(str(tmp_path))
+        for _ in range(3):
+            ds.ingest_segments(_segs(5))
+        before = ds.query(SID)
+        ds.compact()
+        assert ds.query(SID) == before
+        # and the cached handle list now reflects the single base segment
+        assert len(ds.live_segments(2, 756425)) == 1
+
+    def test_lru_bound_holds(self, tmp_path):
+        from reporter_tpu.utils import metrics
+        ds = LocalDatastore(str(tmp_path), handle_cache_size=1)
+        other = make_segment_id(0, 99, 1)
+        ds.ingest_segments(_segs(5))
+        ds.ingest_segments(_segs(5, sid=other, nid=None))
+        a = ds.query(SID)
+        b = ds.query(other)
+        metrics.default.reset()
+        # alternating partitions with a 1-entry cache: every read misses,
+        # results stay correct
+        assert ds.query(SID) == a and ds.query(other) == b
+        assert len(ds._handles) == 1
+        c = metrics.snapshot()["counters"]
+        assert c.get("datastore.query.cache.hits") is None
+
+    def test_cache_disabled_at_zero(self, tmp_path):
+        ds = LocalDatastore(str(tmp_path), handle_cache_size=0)
+        ds.ingest_segments(_segs(5))
+        assert ds.query(SID)["count"] == 5
+        assert ds.query(SID)["count"] == 5
+        assert len(ds._handles) == 0
+
+
+class TestCompactPolicy:
+    """PR-4 satellite: `datastore compact` gains --max-deltas /
+    --max-delta-bytes thresholds (and the worker tee the same knobs), so
+    compaction no longer needs a manual operator pass."""
+
+    def test_max_deltas_threshold(self, tmp_path):
+        ds = LocalDatastore(str(tmp_path))
+        for _ in range(3):
+            ds.ingest_segments(_segs(5))
+        out = ds.compact(max_deltas=5)  # 3 deltas <= 5: below pressure
+        assert out == {"partitions": 0, "merged_segments": 0, "skipped": 1}
+        out = ds.compact(max_deltas=2)  # 3 > 2: compacts
+        assert out["partitions"] == 1 and out["merged_segments"] == 3
+        # a lone base segment exerts no delta pressure
+        out = ds.compact(max_deltas=0)
+        assert out["partitions"] == 0 and out["skipped"] == 1
+
+    def test_max_delta_bytes_threshold(self, tmp_path):
+        ds = LocalDatastore(str(tmp_path))
+        ds.ingest_segments(_segs(5))
+        ds.ingest_segments(_segs(5))
+        assert ds.compact(max_delta_bytes=1 << 30)["partitions"] == 0
+        out = ds.compact(max_delta_bytes=16)  # any real delta is bigger
+        assert out["partitions"] == 1 and out["merged_segments"] == 2
+
+    def test_cli_passes_thresholds(self, tmp_path, capsys):
+        from reporter_tpu.tools import datastore_cli
+        ds = LocalDatastore(str(tmp_path))
+        for _ in range(4):
+            ds.ingest_segments(_segs(5))
+        assert datastore_cli.main(
+            ["compact", str(tmp_path), "--max-deltas", "8"]) == 0
+        out = json.loads(capsys.readouterr().out)
+        assert out["skipped"] == 1 and out["partitions"] == 0
+        assert datastore_cli.main(
+            ["compact", str(tmp_path), "--max-deltas", "3"]) == 0
+        out = json.loads(capsys.readouterr().out)
+        assert out["partitions"] == 1 and out["merged_segments"] == 4
+
+    def test_worker_tee_auto_compacts(self, tmp_path):
+        """The worker --datastore tee with thresholds keeps the
+        partition's delta count bounded without any manual compact —
+        pressure-checked inline on the partitions each flush touched."""
+        from reporter_tpu.datastore import LocalDatastore as LDS
+        ds = LDS(str(tmp_path))
+        for _ in range(6):
+            ds.ingest_segments(_segs(5), max_deltas=2)
+        # never more than max_deltas+1 segments linger (the policy kicks
+        # in as soon as pressure crosses the bound)
+        assert ds.stats()["segments"] <= 3
+        assert ds.query(SID)["count"] == 30

@@ -162,10 +162,10 @@ def test_abi_bad_fixture_catches_every_drift_class():
     assert rules == {"ABI001", "ABI002", "ABI003", "ABI004", "ABI005"}
 
 
-def test_abi_live_pair_validates_at_version_10():
+def test_abi_live_pair_validates_at_version_11():
     cpp = _read(LIVE_CPP)
     exports, version = abi.parse_cpp(cpp)
-    assert version == 10
+    assert version == 11
     assert "rt_prepare_batch" in exports and "rt_assemble_batch" in exports
     findings = abi.check(cpp, _read(LIVE_PY))
     assert findings == [], [f.render() for f in findings]

@@ -19,6 +19,10 @@ REQUIRED_TOP = ("metric", "value", "unit", "vs_baseline", "stages",
                 "baseline", "probe")
 REQUIRED_STAGES = ("prep", "decode_dispatch", "decode_wait", "assemble",
                    "report", "total", "prep_share", "pipelined")
+# native prep phase split (candidates / select / routes) — present
+# whenever the C++ runtime ran the prep, which CI guarantees via the
+# build stage; a dropped phase counter fails here, not in a review
+REQUIRED_NATIVE_STAGES = ("prep_candidates", "prep_select", "prep_routes")
 
 
 def main() -> int:
@@ -51,6 +55,14 @@ def main() -> int:
     missing = [k for k in REQUIRED_TOP if k not in art]
     stages = art.get("stages", {})
     missing += [f"stages.{k}" for k in REQUIRED_STAGES if k not in stages]
+    try:
+        from reporter_tpu import native
+        native_ok = native.available()
+    except Exception:
+        native_ok = False
+    if native_ok:
+        missing += [f"stages.{k}" for k in REQUIRED_NATIVE_STAGES
+                    if k not in stages]
     if missing:
         sys.stderr.write(f"bench smoke: missing keys: {missing}\n")
         return 1

@@ -13,7 +13,7 @@ set -u
 ROOT="$(cd "$(dirname "$0")/.." && pwd)"
 NATIVE="$ROOT/reporter_tpu/native"
 CXX="${CXX:-g++}"
-TESTS="tests/test_native.py tests/test_native_batch.py"
+TESTS="tests/test_native.py tests/test_native_batch.py tests/test_prep_v2.py"
 
 probe() {
     # can this compiler link the sanitizer runtime at all?
@@ -37,6 +37,7 @@ if probe address; then
     LD_PRELOAD="$libasan $libstdcxx" \
     ASAN_OPTIONS="detect_leaks=0:abort_on_error=1" \
     REPORTER_TPU_NATIVE_LIB="$NATIVE/libreporter_host_asan.so" \
+    REPORTER_TPU_PREP_THREADS=2 \
     JAX_PLATFORMS=cpu \
         python -m pytest $TESTS -q -p no:cacheprovider || rc=1
 else
@@ -49,6 +50,7 @@ if probe undefined; then
     make -C "$NATIVE" ubsan || exit 1
     UBSAN_OPTIONS="print_stacktrace=1:halt_on_error=1" \
     REPORTER_TPU_NATIVE_LIB="$NATIVE/libreporter_host_ubsan.so" \
+    REPORTER_TPU_PREP_THREADS=2 \
     JAX_PLATFORMS=cpu \
         python -m pytest $TESTS -q -p no:cacheprovider || rc=1
 else
