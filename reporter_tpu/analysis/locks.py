@@ -39,6 +39,8 @@ THREADED_PREFIXES = (
     "reporter_tpu/service/",
     "reporter_tpu/utils/metrics.py",
     "reporter_tpu/utils/runtime.py",
+    "reporter_tpu/utils/faults.py",
+    "reporter_tpu/utils/circuit.py",
     "reporter_tpu/native/__init__.py",
 )
 

@@ -37,6 +37,9 @@ def _fmt_float(v: float) -> str:
 _POINT_STRUCT = struct.Struct(">ffiq")  # big-endian like java.nio ByteBuffer
 
 
+_F32 = struct.Struct(">ff")
+
+
 @dataclass
 class Point:
     lat: float
@@ -45,6 +48,16 @@ class Point:
     time: int
 
     SIZE = _POINT_STRUCT.size  # 20
+
+    def __post_init__(self):
+        # the f32 wire format IS the value domain: quantise at
+        # construction so every serde roundtrip (Kafka frame, state
+        # snapshot) is the identity. Before this, a point restored from
+        # a crash snapshot differed from its never-snapshotted twin in
+        # the f32-truncated digits — enough to flip a rounded report
+        # duration and break crash/restore output parity (the chaos
+        # harness's kill_restore scenario caught exactly that).
+        self.lat, self.lon = _F32.unpack(_F32.pack(self.lat, self.lon))
 
     def to_bytes(self) -> bytes:
         return _POINT_STRUCT.pack(self.lat, self.lon, self.accuracy, self.time)

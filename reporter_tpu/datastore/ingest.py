@@ -66,10 +66,11 @@ def parse_tile_csv(payload: str) -> ObservationBatch:
 
 
 def scan_tiles(root: str,
-               skip_names: tuple = (".deadletter",)) -> Iterator[str]:
+               skip_names: tuple = (".deadletter", ".traces")) -> Iterator[str]:
     """Yield tile file paths under an anonymiser output (or dead-letter)
-    directory, skipping the dead-letter spool and dot-state files when
-    scanning a results root."""
+    directory, skipping the dead-letter spool, the batcher's trace-JSON
+    spool (``.traces`` — request bodies, not tile CSV) and dot-state
+    files when scanning a results root."""
     for dirpath, dirnames, filenames in os.walk(root):
         dirnames[:] = sorted(d for d in dirnames if d not in skip_names)
         for name in sorted(filenames):

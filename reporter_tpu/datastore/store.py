@@ -41,7 +41,7 @@ from typing import Dict, Iterator, List, Optional, Tuple
 
 import numpy as np
 
-from ..utils import metrics
+from ..utils import faults, metrics
 from .aggregate import Delta, aggregate, merge_deltas
 from .schema import ObservationBatch
 
@@ -124,6 +124,11 @@ class HistogramStore:
     # -- write path --------------------------------------------------------
     def append(self, level: int, index: int, delta: Delta) -> str:
         """Commit one delta as a new immutable segment; returns its name."""
+        # failure domain: a failed commit surfaces to the caller (the
+        # worker tee logs-and-continues; `datastore ingest` quarantines
+        # the tile) and the crash-safe protocol below leaves only an
+        # ignorable temp dir behind
+        faults.failpoint("datastore.commit")
         with self._lock, metrics.timer("datastore.store.append"):
             pdir = self.partition_dir(level, index)
             os.makedirs(pdir, exist_ok=True)

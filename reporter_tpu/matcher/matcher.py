@@ -15,7 +15,9 @@ Viterbi per padding bucket.
 from __future__ import annotations
 
 import json
+import logging
 import os
+import threading
 from concurrent.futures import ThreadPoolExecutor
 from typing import List, Optional, Sequence
 
@@ -25,7 +27,8 @@ from ..core.tracebatch import TraceBatch, as_trace_batch
 from ..graph.network import RoadNetwork
 from ..graph.route import RouteCache
 from ..graph.spatial import SpatialGrid
-from ..utils import metrics
+from ..utils import faults, metrics
+from ..utils.circuit import CircuitBreaker
 from .assemble import assemble_segments
 from .batchpad import (LENGTH_BUCKETS, pack_batches, padded_batch_rows,
                        prepare_batch, prepare_trace, prepare_traces_numpy)
@@ -34,6 +37,15 @@ from .params import MatchParams
 # process-wide configuration, mirroring valhalla.Configure's module-level
 # behavior (reference: reporter_service.py:284)
 _global_config: dict = {}
+
+logger = logging.getLogger("reporter_tpu.matcher")
+
+
+def _circuit_knobs() -> tuple:
+    """(threshold, cooldown_s) for the native-prep circuit breaker."""
+    from ..utils.runtime import _env_float, _env_int
+    return (_env_int("REPORTER_TPU_CIRCUIT_THRESHOLD", 5),
+            _env_float("REPORTER_TPU_CIRCUIT_COOLDOWN_S", 30.0))
 
 
 def _decode_chunk() -> int:
@@ -310,9 +322,14 @@ class SegmentMatcher:
         self.params = params
         self._grid_cell_m = grid_cell_m
         # the numpy structures are only built if the fallback path is used
-        # (the native runtime owns its own grid and cache)
+        # (the native runtime owns its own grid and cache). Lazy-built
+        # under a lock: with the circuit breaker, concurrent native-path
+        # callers can reach the fallback simultaneously, and a bare
+        # check-then-set would race duplicate SpatialGrid/RouteCache
+        # builds (losing one copy's cache warmth exactly when degraded)
         self._grid: Optional[SpatialGrid] = None
         self._route_cache: Optional[RouteCache] = None
+        self._fallback_lock = threading.Lock()
         # C++ host runtime when available (and not explicitly disabled);
         # numpy fallback otherwise — identical contract
         self.runtime = None
@@ -323,6 +340,16 @@ class SegmentMatcher:
             elif use_native:
                 raise RuntimeError("native host runtime requested but "
                                    "unavailable")
+        # failure domain for native prep: N consecutive prep errors open
+        # the circuit and route whole chunks through the numpy fallback
+        # (outputs pinned byte-identical by tests/test_report_writer.py);
+        # a half-open probe after the cooldown feels out recovery. The
+        # breaker exists even without a runtime (it just never trips) so
+        # /health can always report a state.
+        threshold, cooldown = _circuit_knobs()
+        self.circuit = CircuitBreaker("matcher.circuit",
+                                      threshold=threshold,
+                                      cooldown_s=cooldown)
         # two single-worker device lanes, each FIFO: the dispatch lane
         # runs decode dispatch + async d2h so the device queue stays fed,
         # the drain lane runs the d2h wait + assembly — so chunk N's
@@ -339,13 +366,18 @@ class SegmentMatcher:
     @property
     def grid(self) -> SpatialGrid:
         if self._grid is None:
-            self._grid = SpatialGrid(self.net, cell_m=self._grid_cell_m)
+            with self._fallback_lock:
+                if self._grid is None:
+                    self._grid = SpatialGrid(self.net,
+                                             cell_m=self._grid_cell_m)
         return self._grid
 
     @property
     def route_cache(self) -> RouteCache:
         if self._route_cache is None:
-            self._route_cache = RouteCache(self.net)
+            with self._fallback_lock:
+                if self._route_cache is None:
+                    self._route_cache = RouteCache(self.net)
         return self._route_cache
 
     # -- single-trace, reference-shaped API --------------------------------
@@ -569,7 +601,14 @@ class SegmentMatcher:
         (vectorised), then ONE rt_prepare_batch call per chunk on this
         thread — the chunk's flat coordinate columns pass straight from
         the TraceBatch to the native call, zero per-point Python —
-        handing each prepared batch to ``submit`` (the device lanes)."""
+        handing each prepared batch to ``submit`` (the device lanes).
+
+        Failure domain: each chunk consults the circuit breaker. A
+        native prep error degrades THAT chunk to the numpy path (the
+        caller still gets every result) and counts a breaker failure;
+        enough consecutive failures open the circuit and subsequent
+        chunks skip native entirely until a half-open probe succeeds.
+        """
         workers = max(1, _prep_workers())
         buckets = np.asarray(LENGTH_BUCKETS, dtype=np.int64)
         # bucket by RAW length (kept length is only known after the
@@ -591,12 +630,51 @@ class SegmentMatcher:
                     # (reporter-lint HP003)
                     order = part
                     rows = padded_batch_rows(len(part), pad)
-                    with metrics.timer("matcher.prep"):
-                        batch = prepare_batch(
-                            self.runtime, tb.gather(part),
-                            params, int(T), pad_rows=rows,
-                            n_threads=workers)
+                    if not self.circuit.allow():
+                        metrics.count("matcher.circuit.fallback_chunks")
+                        self._submit_numpy_chunk(tb, part, params, pad,
+                                                 submit, sigma, beta)
+                        continue
+                    try:
+                        with metrics.timer("matcher.prep"):
+                            faults.failpoint("native.prep")
+                            batch = prepare_batch(
+                                self.runtime, tb.gather(part),
+                                params, int(T), pad_rows=rows,
+                                n_threads=workers)
+                    except Exception as e:
+                        self.circuit.record_failure()
+                        metrics.count("matcher.circuit.native_errors")
+                        logger.warning(
+                            "native prep failed for a %d-trace chunk "
+                            "(%s); serving it via the numpy fallback",
+                            len(part), e)
+                        self._submit_numpy_chunk(tb, part, params, pad,
+                                                 submit, sigma, beta)
+                        continue
+                    self.circuit.record_success()
                     submit(batch, order, sigma, beta)
+
+    def _submit_numpy_chunk(self, tb: TraceBatch, part, params, pad,
+                            submit, sigma, beta) -> None:
+        """Prep ONE chunk through the numpy path and hand its packed
+        batches to the device lanes — the degraded lane the circuit
+        breaker routes native chunks through, and the inner step of
+        ``_dispatch_fallback``. Contract identical to native prep
+        (results pinned byte-equal by tests/test_report_writer.py)."""
+        with metrics.timer("matcher.prep"):
+            prepped = prepare_traces_numpy(
+                self.net, self.grid, tb.gather(part), params,
+                self.route_cache)
+        # chunk-granular identity bookkeeping on the numpy fallback
+        # path (one small dict per chunk, not per point)
+        idx_of = {id(p): i for p, i in zip(prepped, part)}
+        for batch in pack_batches(prepped, pad_batch_to=pad,
+                                  pad_pow2=True):
+            # rows of a packed batch align with its traces list, so
+            # order[b] is the global index of batch.traces[b]
+            order = [idx_of[id(p)] for p in batch.traces]
+            submit(batch, order, sigma, beta)
 
     def _dispatch_fallback(self, tb: TraceBatch, per_trace_params, chunk,
                            pad, submit):
@@ -608,18 +686,5 @@ class SegmentMatcher:
             sigma = np.float32(params.effective_sigma)
             beta = np.float32(params.beta)
             for lo in range(0, len(idxs), chunk):
-                part = idxs[lo:lo + chunk]
-                with metrics.timer("matcher.prep"):
-                    prepped = prepare_traces_numpy(
-                        self.net, self.grid, tb.gather(part), params,
-                        self.route_cache)
-                # chunk-granular identity bookkeeping on the numpy
-                # fallback path (one small dict per chunk, not per point)
-                idx_of = {id(p): i  # lint: ignore[HP002]
-                          for p, i in zip(prepped, part)}
-                for batch in pack_batches(prepped, pad_batch_to=pad,
-                                          pad_pow2=True):
-                    # rows of a packed batch align with its traces list,
-                    # so order[b] is the global index of batch.traces[b]
-                    order = [idx_of[id(p)] for p in batch.traces]
-                    submit(batch, order, sigma, beta)
+                self._submit_numpy_chunk(tb, idxs[lo:lo + chunk], params,
+                                         pad, submit, sigma, beta)

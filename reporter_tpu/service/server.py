@@ -38,8 +38,10 @@ from .report import report, report_json
 # /report is the reference's only action (reporter_service.py:26);
 # /stats is new — a metrics snapshot (counters + stage timers);
 # /histogram is the datastore query surface (datastore/query.py), live
-# when the service was built with a datastore attached
-ACTIONS = {"report", "stats", "histogram"}
+# when the service was built with a datastore attached;
+# /health is the failure-domain probe: graph, native runtime vs numpy
+# fallback, circuit state, datastore reachability — 200 or 503
+ACTIONS = {"report", "stats", "histogram", "health"}
 
 
 class ReporterService:
@@ -125,6 +127,45 @@ class ReporterService:
             return 400, json.dumps({"error": str(e)})
         return 200, json.dumps(result, separators=(",", ":"))
 
+    def health(self) -> tuple[int, str]:
+        """Liveness + degradation probe; (status, JSON body).
+
+        200 means fully serving: graph loaded and the datastore (when
+        attached) reachable. 503 flags a degraded domain a load balancer
+        should rotate away from: the native-prep circuit OPEN (still
+        serving, via the numpy fallback, but slower) or the datastore
+        erroring. The body always enumerates every domain either way.
+        """
+        from ..utils import faults
+        m = self.matcher
+        circuit = m.circuit.snapshot()
+        body = {
+            "graph": {"loaded": m.net is not None,
+                      "nodes": int(m.net.num_nodes),
+                      "edges": int(m.net.num_edges)},
+            "native": {"status": "native" if m.runtime is not None
+                       else "fallback"},
+            "circuit": circuit,
+            "faults": faults.active_spec(),
+        }
+        healthy = True
+        if circuit["state"] == "open":
+            healthy = False
+        if self.datastore is None:
+            body["datastore"] = {"status": "absent"}
+        else:
+            try:
+                stats = self.datastore.stats()
+                body["datastore"] = {"status": "ok",
+                                     "partitions": stats["partitions"],
+                                     "rows": stats["rows"]}
+            except Exception as e:
+                body["datastore"] = {"status": "error", "error": str(e)}
+                healthy = False
+        body["status"] = "ok" if healthy else "degraded"
+        return (200 if healthy else 503,
+                json.dumps(body, separators=(",", ":")))
+
     def report_many(self, traces) -> list:
         """Match + report a whole list — or a columnar
         :class:`TraceBatch` — in ONE dispatcher round trip (one device
@@ -209,6 +250,12 @@ def make_handler(service: ReporterService):
             action = urllib.parse.urlsplit(self.path).path.split("/")[-1]
             if action == "stats":
                 self._respond(200, json.dumps(metrics.snapshot()))
+                return
+            if action == "health":
+                code, body = service.health()
+                if code != 200:
+                    metrics.count(f"service.errors.{code}")
+                self._respond(code, body)
                 return
             if action == "histogram":
                 try:

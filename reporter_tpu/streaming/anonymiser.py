@@ -22,10 +22,10 @@ from __future__ import annotations
 import logging
 import os
 import tempfile
-import uuid as uuidlib
 from typing import Dict, List, Optional
 
 from ..core.types import Segment, TimeQuantisedTile
+from ..utils import faults
 from ..utils import http as http_egress
 from ..utils import metrics
 
@@ -89,6 +89,12 @@ class TileSink:
     def store(self, tile_name: str, file_name: str, payload: str) -> bool:
         ok = False
         try:
+            # failure domain: the before-hook models a sink that never
+            # got the payload (error/timeout/crash); the after-hook
+            # models a committed-but-unacknowledged write (kind=partial)
+            # — the duplicate-risk window the epoch-stamped file names
+            # (the sink idempotency key) exist to absorb
+            faults.failpoint("egress.http")
             if self.is_http:
                 # signed PUT for AWS endpoints, plain POST otherwise
                 # (reference: AnonymisingProcessor.java:177-220)
@@ -102,7 +108,10 @@ class TileSink:
                 with open(os.path.join(path, file_name), "w") as f:
                     f.write(payload)
                 ok = True
+            if ok:
+                faults.failpoint("egress.http", after=True)
         except Exception as e:
+            ok = False
             logger.error("Couldn't flush tile to sink %s/%s: %s",
                          tile_name, file_name, e)
         if ok:
@@ -157,9 +166,28 @@ class Anonymiser:
         # (datastore.LocalDatastore.ingest_segments); a tee failure is
         # logged but never blocks tile egress
         self.tee = tee
+        # monotonic flush epoch: stamped into every tile file name this
+        # flush emits (the sink idempotency key) and carried in the
+        # StateStore snapshot. The reference named files {source}.{uuid4}
+        # (AnonymisingProcessor.java:209) — random names mean a crash
+        # between egress and snapshot re-emits the same segments under a
+        # NEW name (duplicate tiles); deterministic epoch names make the
+        # re-emit overwrite byte-identically, and a committed-epoch
+        # marker lets restore skip the epoch outright (state.py).
+        self.flush_epoch = 0
+        # optional writer id distinguishing concurrent workers sharing
+        # one sink (multihost): without it two workers' epoch-0 files
+        # for one tile would collide
+        self.writer_id = os.environ.get("REPORTER_TPU_WRITER_ID", "")
         # tile -> current slice number; "tile.slice" -> segments
         self.slice_of: Dict[TimeQuantisedTile, int] = {}
         self.slices: Dict[str, List[Segment]] = {}
+
+    def epoch_file_name(self, epoch: int) -> str:
+        """The deterministic per-flush file name: one flush writes at
+        most one file per tile dir, so source + epoch identifies it."""
+        writer = f".{self.writer_id}" if self.writer_id else ""
+        return f"{self.source}{writer}.e{epoch:08d}"
 
     def process(self, key: str, segment: Segment) -> None:
         for tile in TimeQuantisedTile.tiles_for(segment, self.quantisation):
@@ -175,8 +203,13 @@ class Anonymiser:
 
     def punctuate(self) -> int:
         """Flush every tile: gather slices, sort, cull, store. Returns the
-        number of tiles written."""
+        number of tiles written. Every flush consumes one epoch (bumped
+        even when nothing qualifies, so epoch numbering is a pure
+        function of the punctuation sequence — deterministic replays
+        stay deterministic)."""
         written = 0
+        epoch = self.flush_epoch
+        file_name = self.epoch_file_name(epoch)
         for tile, max_slice in list(self.slice_of.items()):
             del self.slice_of[tile]
             segments: List[Segment] = []
@@ -207,7 +240,6 @@ class Anonymiser:
                 tile.time_range_start,
                 tile.time_range_start + self.quantisation - 1,
                 tile.tile_level(), tile.tile_index())
-            file_name = f"{self.source}.{uuidlib.uuid4()}"
             logger.info("Writing tile to %s/%s/%s with %d segments",
                         self.sink.output, tile_name, file_name, len(segments))
             if self.sink.store(tile_name, file_name, payload):
@@ -217,4 +249,5 @@ class Anonymiser:
             logger.warning("Deleting unreferenced quantised tile slice %s",
                            name)
             del self.slices[name]
+        self.flush_epoch = epoch + 1
         return written

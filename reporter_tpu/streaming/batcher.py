@@ -15,6 +15,13 @@ The streaming analog of the reference's Batch + BatchingProcessor
   (BatchingProcessor.java:108-141)
 - an unparseable matcher response drops the whole batch (Batch.java:83-87)
 
+One reference behavior is deliberately NOT preserved: a failed submit no
+longer silently drops the batch. Transient failures requeue the batch
+under a small retry budget (``REPORTER_TPU_SUBMIT_RETRIES``, counted as
+``batch.requeued``); an exhausted budget dead-letters the trace JSON to
+a spool directory for replay and counts ``batch.dropped`` — the matcher
+outage failure domain has a defined degraded mode instead of data loss.
+
 What changed for the TPU: the matcher call is pluggable — an in-process
 ``ReporterService.handle`` (which micro-batches across uuids on the device)
 instead of one HTTP POST per trace, though an HTTP submitter is provided
@@ -24,6 +31,7 @@ from __future__ import annotations
 
 import json
 import logging
+import os
 from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -32,6 +40,7 @@ from ..core.geo import equirectangular_m
 from ..core.osmlr import INVALID_SEGMENT_ID
 from ..core.tracebatch import TraceBatch, TraceView
 from ..core.types import Point, Segment
+from ..utils import faults, metrics
 
 logger = logging.getLogger("reporter_tpu.streaming")
 
@@ -42,11 +51,14 @@ SESSION_GAP_MS = 60000  # milliseconds (:29)
 
 
 class Batch:
-    __slots__ = ("max_separation", "last_update", "points")
+    __slots__ = ("max_separation", "last_update", "points", "retries")
 
     def __init__(self, point: Optional[Point] = None):
         self.max_separation = 0.0
         self.last_update = 0
+        # consecutive failed submit attempts (bounded-requeue budget);
+        # carried in the state snapshot so a restart keeps the bound
+        self.retries = 0
         self.points: List[Point] = [point] if point is not None else []
 
     def update(self, p: Point) -> None:
@@ -205,7 +217,9 @@ class PointBatcher:
                  session_gap_ms: int = SESSION_GAP_MS,
                  submit_many: Optional[Callable[
                      [List[dict]], List[Optional[dict]]]] = None,
-                 report_flush: int = 64):
+                 report_flush: int = 64,
+                 retry_budget: Optional[int] = None,
+                 deadletter_dir: Optional[str] = None):
         self.submit = submit
         # batched submit for flush paths (one device batch for a whole
         # punctuate/pending flush); falls back to per-uuid submit
@@ -231,6 +245,18 @@ class PointBatcher:
         # same results, the window just extends by a few probes.
         self.pending: Dict[str, None] = {}
         self.report_flush = max(1, int(report_flush))
+        # bounded requeue: how many consecutive failed submits a live
+        # batch survives before its trace JSON dead-letters (the
+        # reference silently dropped the batch on the FIRST failure,
+        # Batch.java:83-87)
+        if retry_budget is None:
+            from ..utils.runtime import _env_int
+            retry_budget = _env_int("REPORTER_TPU_SUBMIT_RETRIES", 2)
+        self.retry_budget = max(0, retry_budget)
+        # spool for exhausted batches' trace JSON (None = log-and-drop);
+        # files replay by POSTing their body to any /report endpoint
+        self.deadletter_dir = deadletter_dir
+        self._deadletter_seq = 0
 
     def _submit_safe(self, body) -> Optional[dict]:
         if isinstance(body, TraceView):
@@ -268,15 +294,76 @@ class PointBatcher:
     def _flush_due(self, due) -> None:
         """ONE batched submit for (uuid, batch) pairs -> forward the
         resulting segment pairs; bodies go columnar (TraceBatch), so the
-        in-process service path never builds a point dict."""
+        in-process service path never builds a point dict.
+
+        Failure domain: a failed round trip (a whole-submit exception or
+        a per-trace None) requeues the batch under the retry budget and
+        then dead-letters it — an infrastructure hiccup must neither
+        kill the stream thread nor silently lose the trace."""
         if not due:
             return
         tb = TraceBatch.concat([
             batch.request_columns(uuid, self.options)
             for uuid, batch in due])
-        responses = self.submit_many(tb)
+        try:
+            faults.failpoint("matcher.submit")
+            responses = self.submit_many(tb)
+        except Exception as e:
+            logger.error("batched submit failed for %d traces: %s",
+                         len(due), e)
+            responses = [None] * len(due)
         for (uuid, batch), response in zip(due, responses):
+            if response is None:
+                self._submit_failed(uuid, batch)
+                continue
+            batch.retries = 0
             self._forward_all(batch.apply_response(uuid, response))
+
+    def _submit_failed(self, uuid: str, batch: Batch) -> None:
+        """One failed round trip: requeue a live batch under the budget,
+        dead-letter it (and evicted batches, which have no next flush to
+        ride) once the budget is spent."""
+        if self.store.get(uuid) is batch \
+                and batch.retries < self.retry_budget:
+            batch.retries += 1
+            self.pending[uuid] = None
+            metrics.count("batch.requeued")
+            logger.warning("submit failed for %s; requeued (%d/%d)",
+                           uuid, batch.retries, self.retry_budget)
+            return
+        metrics.count("batch.dropped")
+        self._deadletter(uuid, batch)
+        batch.drop()
+        # the budget is per report attempt: a session that re-qualifies
+        # after this drop gets a fresh budget, not a permanent ban
+        batch.retries = 0
+
+    def _deadletter(self, uuid: str, batch: Batch) -> None:
+        """Spool the batch's request JSON for replay; best-effort — the
+        spool failing must not take the stream down with it."""
+        if self.deadletter_dir is None or not batch.points:
+            logger.error("Dropping batch for %s after %d failed submits "
+                         "(%d points, no dead-letter spool)",
+                         uuid, batch.retries + 1, len(batch.points))
+            return
+        body = batch.request_body(uuid, self.mode, self.report_on,
+                                  self.transition_on)
+        self._deadletter_seq += 1
+        # pid-qualified: the sequence restarts with the process, and a
+        # colliding name would os.replace an earlier spooled trace away
+        name = f"trace-{os.getpid()}-{self._deadletter_seq:06d}" \
+               f".{uuid}.json"
+        try:
+            os.makedirs(self.deadletter_dir, exist_ok=True)
+            path = os.path.join(self.deadletter_dir, name)
+            with open(path + ".tmp", "w", encoding="utf-8") as f:
+                json.dump(body, f, separators=(",", ":"))
+            os.replace(path + ".tmp", path)
+            metrics.count("batch.deadletter")
+            logger.warning("Dead-lettered trace for %s -> %s", uuid, path)
+        except Exception as e:
+            logger.error("Trace dead-letter spool failed for %s: %s",
+                         uuid, e)
 
     def flush_pending(self) -> None:
         """Flush every session that crossed the report thresholds since

@@ -11,16 +11,38 @@ the worker's two state stores, restored on startup. The wire layouts are
 the framework's own fixed-width serdes (Point 20 B, Segment 40 B,
 TimeQuantisedTile 16 B — core/types.py), so the snapshot stays compact
 and the serde code paths get exercised in production. Writes go to a tmp
-file then ``os.replace`` so a crash mid-write leaves the previous
-snapshot intact; restore of a truncated/corrupt file is treated as "no
-snapshot" (the reference's crash semantics) rather than an error.
+file (fsync'd, then the directory fsync'd after ``os.replace`` — a bare
+rename can survive a power loss as an empty file) so a crash mid-write
+leaves the previous snapshot intact; restore of a truncated/corrupt file
+is treated as "no snapshot" (the reference's crash semantics) rather
+than an error.
 
-Layout (little-endian, "RTS1" magic):
+Exactly-once-ish egress (three-step flush protocol, worker._flush_tiles):
+(1) a PRE-egress snapshot makes the report trims that fed the flush
+durable — no crash can restore untrimmed batches that would re-report
+already-egressed segments; (2) the tiles egress under deterministic
+epoch file names; (3) :meth:`StateStore.commit_epoch` durably marks the
+epoch fully egressed in a sidecar file (``<path>.epoch``) before the
+post-flush snapshot. A crash after the marker restores the pre-flush
+snapshot, detects ``committed >= snapshot.flush_epoch`` and skips the
+epoch — clearing the restored slices instead of double-emitting them; a
+crash *before* the marker re-emits the epoch under the same names,
+which the file sink overwrites byte-identically and remote sinks dedupe
+on — every window is covered.
+
+Layout (little-endian, "RTS1" magic, version 2; v1 snapshots predate the
+flush epoch and are discarded as corrupt — the reference's crash
+semantics, one replay window wide):
 
   header:  4s magic | u32 version | u64 snapshot_unix_ms
+  epoch:   u64 flush_epoch
   batches: u32 count, then per uuid:
            u16 uuid_len | uuid utf-8 | f32 max_separation |
-           u64 last_update_ms | u32 n_points | n_points * Point
+           u64 last_update_ms | u32 retries | u32 n_points |
+           n_points * Point
+  pending: u32 count, then per uuid: u16 uuid_len | uuid utf-8
+           (sessions awaiting a batched report flush — restoring them
+           keeps flush boundaries deterministic across a crash)
   slices:  u32 count, then per slice:
            u16 name_len | name utf-8 | u32 n_segments | n * Segment
   slice_of: u32 count, then per tile: Tile | u32 slice_no
@@ -34,17 +56,19 @@ import time
 from typing import Optional
 
 from ..core.types import Point, Segment, TimeQuantisedTile
+from ..utils import faults, metrics
 from .batcher import Batch, PointBatcher
 from .anonymiser import Anonymiser
 
 logger = logging.getLogger("reporter_tpu.streaming")
 
 _MAGIC = b"RTS1"
-_VERSION = 1
+_VERSION = 2
 _HEADER = struct.Struct("<4sIQ")
 _U16 = struct.Struct("<H")
 _U32 = struct.Struct("<I")
-_BATCH_META = struct.Struct("<fQI")
+_U64 = struct.Struct("<Q")
+_BATCH_META = struct.Struct("<fQII")
 
 
 def _pack_str(out: bytearray, s: str) -> None:
@@ -71,6 +95,9 @@ class _Reader:
     def u32(self) -> int:
         return _U32.unpack(self.take(4))[0]
 
+    def u64(self) -> int:
+        return _U64.unpack(self.take(8))[0]
+
     def string(self) -> str:
         return self.take(self.u16()).decode("utf-8")
 
@@ -78,14 +105,19 @@ class _Reader:
 def snapshot_bytes(batcher: PointBatcher, anonymiser: Anonymiser) -> bytes:
     out = bytearray()
     out += _HEADER.pack(_MAGIC, _VERSION, int(time.time() * 1000))
+    out += _U64.pack(anonymiser.flush_epoch)
 
     out += _U32.pack(len(batcher.store))
     for uuid, batch in batcher.store.items():
         _pack_str(out, uuid)
         out += _BATCH_META.pack(batch.max_separation, batch.last_update,
-                                len(batch.points))
+                                batch.retries, len(batch.points))
         for p in batch.points:
             out += p.to_bytes()
+
+    out += _U32.pack(len(batcher.pending))
+    for uuid in batcher.pending:
+        _pack_str(out, uuid)
 
     out += _U32.pack(len(anonymiser.slices))
     for name, segments in anonymiser.slices.items():
@@ -111,18 +143,24 @@ def restore_bytes(raw: bytes, batcher: PointBatcher,
     magic, version, _ts = _HEADER.unpack(r.take(_HEADER.size))
     if magic != _MAGIC or version != _VERSION:
         raise ValueError(f"bad snapshot header {magic!r} v{version}")
+    flush_epoch = r.u64()
 
     store = {}
     for _ in range(r.u32()):
         uuid = r.string()
-        max_sep, last_update, n_points = _BATCH_META.unpack(
+        max_sep, last_update, retries, n_points = _BATCH_META.unpack(
             r.take(_BATCH_META.size))
         batch = Batch()
         batch.max_separation = max_sep
         batch.last_update = last_update
+        batch.retries = retries
         for _ in range(n_points):
             batch.points.append(Point.from_bytes(r.take(Point.SIZE)))
         store[uuid] = batch
+
+    pending = {}
+    for _ in range(r.u32()):
+        pending[r.string()] = None
 
     slices = {}
     for _ in range(r.u32()):
@@ -137,8 +175,10 @@ def restore_bytes(raw: bytes, batcher: PointBatcher,
 
     # parse succeeded in full — apply atomically
     batcher.store.update(store)
+    batcher.pending.update(pending)
     anonymiser.slices.update(slices)
     anonymiser.slice_of.update(slice_of)
+    anonymiser.flush_epoch = flush_epoch
 
 
 class StateStore:
@@ -158,29 +198,112 @@ class StateStore:
         parent = os.path.dirname(os.path.abspath(path))
         os.makedirs(parent, exist_ok=True)
 
+    # -- committed-epoch marker --------------------------------------------
+    @property
+    def epoch_path(self) -> str:
+        return self.path + ".epoch"
+
+    def commit_epoch(self, epoch: int) -> None:
+        """Durably record that ``epoch``'s tiles fully reached the sink.
+        Called between egress and the post-flush snapshot — it is what
+        lets restore tell "flushed then crashed" from "crashed mid-way"."""
+        tmp = self.epoch_path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            f.write(str(int(epoch)))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.epoch_path)
+        self._fsync_dir()
+
+    def committed_epoch(self) -> int:
+        """The last epoch known to have fully egressed; -1 when none."""
+        try:
+            with open(self.epoch_path, encoding="utf-8") as f:
+                return int(f.read().strip())
+        except (FileNotFoundError, ValueError):
+            return -1
+
+    def _fsync_dir(self) -> None:
+        # directory fsync so the rename itself is durable; best-effort
+        # on filesystems/platforms that refuse O_RDONLY dir fds
+        parent = os.path.dirname(os.path.abspath(self.path))
+        try:
+            fd = os.open(parent, os.O_RDONLY)
+        except OSError:
+            return
+        try:
+            os.fsync(fd)
+        except OSError:
+            pass
+        finally:
+            os.close(fd)
+
+    # -- snapshot ----------------------------------------------------------
     def restore(self, batcher: PointBatcher,
                 anonymiser: Anonymiser) -> bool:
-        """Load state if a snapshot exists; False when starting fresh."""
+        """Load state if a snapshot exists; False when starting fresh.
+
+        When the committed-epoch marker says the snapshot's next flush
+        epoch already reached the sink (the crash landed between egress
+        and snapshot), the restored tile slices are SKIPPED instead of
+        queued for a duplicate emission."""
         try:
             with open(self.path, "rb") as f:
                 raw = f.read()
         except FileNotFoundError:
+            self._seed_epoch(anonymiser)
             return False
         try:
             restore_bytes(raw, batcher, anonymiser)
         except ValueError as e:
             logger.error("Discarding corrupt state snapshot %s: %s",
                          self.path, e)
+            self._seed_epoch(anonymiser)
             return False
-        logger.info("Restored state: %d open batches, %d tile slices",
-                    len(batcher.store), len(anonymiser.slices))
+        committed = self.committed_epoch()
+        if committed >= anonymiser.flush_epoch:
+            dropped = len(anonymiser.slices)
+            anonymiser.slices.clear()
+            anonymiser.slice_of.clear()
+            anonymiser.flush_epoch = committed + 1
+            metrics.count("state.epoch_skipped")
+            logger.warning(
+                "Snapshot pre-dates committed flush epoch %d: skipping "
+                "%d already-egressed tile slices (crash landed between "
+                "egress and snapshot)", committed, dropped)
+        logger.info("Restored state: %d open batches, %d tile slices, "
+                    "flush epoch %d", len(batcher.store),
+                    len(anonymiser.slices), anonymiser.flush_epoch)
         return True
 
+    def _seed_epoch(self, anonymiser: Anonymiser) -> None:
+        """Fresh-start epoch seeding: even with no usable snapshot, a
+        surviving ``.epoch`` marker means epoch-named tiles up to that
+        number are already committed at the sink — restarting the
+        counter at 0 would deterministically OVERWRITE them with
+        different data (the hazard the removed uuid4 names could never
+        hit). Resume numbering past the marker instead."""
+        committed = self.committed_epoch()
+        if committed >= anonymiser.flush_epoch:
+            anonymiser.flush_epoch = committed + 1
+            logger.warning(
+                "No usable snapshot but flush epochs up to %d are "
+                "committed; resuming tile numbering at epoch %d",
+                committed, committed + 1)
+
     def save(self, batcher: PointBatcher, anonymiser: Anonymiser) -> None:
+        faults.failpoint("state.save")
         tmp = self.path + ".tmp"
         with open(tmp, "wb") as f:
             f.write(snapshot_bytes(batcher, anonymiser))
+            f.flush()
+            # fsync BEFORE the rename: os.replace promises atomicity,
+            # not durability — after a power loss an un-fsynced rename
+            # can legally surface as the new name with EMPTY contents
+            os.fsync(f.fileno())
         os.replace(tmp, self.path)
+        self._fsync_dir()
+        faults.failpoint("state.save", after=True)
         self._last_save = self.clock()
 
     def maybe_save(self, batcher: PointBatcher,

@@ -22,12 +22,15 @@ plus --input (flat file / '-' for stdin replay) or --bootstrap/-b with
 from __future__ import annotations
 
 import argparse
+import contextlib
 import json
 import logging
+import os
 import sys
 import time
 from typing import Callable, Iterable, Optional
 
+from ..utils import faults, metrics
 from ..utils import http as http_egress
 from .anonymiser import Anonymiser, TileSink
 from .batcher import PointBatcher, SESSION_GAP_MS
@@ -78,7 +81,8 @@ class StreamWorker:
                  state=None,
                  uuid_filter: Optional[Callable[[str], bool]] = None,
                  submit_many=None,
-                 report_flush_interval_s: float = 1.0):
+                 report_flush_interval_s: float = 1.0,
+                 trace_deadletter: Optional[str] = None):
         self.formatter = formatter
         # multi-host: predicate deciding which uuids this worker owns
         # (parallel.multihost — the Kafka keyed-partition contract when the
@@ -86,10 +90,20 @@ class StreamWorker:
         self.uuid_filter = uuid_filter
         self.skipped_other_host = 0
         self.anonymiser = anonymiser
+        if trace_deadletter is None:
+            # default next to the tile dead-letter spool, dot-prefixed so
+            # `datastore ingest` over that spool never mistakes a trace
+            # JSON for a tile CSV (ingest.scan_tiles skips it by name);
+            # stub sinks without a spool leave it off (log-and-drop)
+            spool = getattr(getattr(anonymiser, "sink", None),
+                            "deadletter", None)
+            if spool:
+                trace_deadletter = os.path.join(spool, ".traces")
         self.batcher = PointBatcher(
             submit, lambda key, seg: self.anonymiser.process(key, seg),
             mode=mode, report_on=reports, transition_on=transitions,
-            session_gap_ms=session_gap_ms, submit_many=submit_many)
+            session_gap_ms=session_gap_ms, submit_many=submit_many,
+            deadletter_dir=trace_deadletter)
         self.flush_interval_s = flush_interval_s
         self.session_gap_ms = session_gap_ms
         self.clock = clock
@@ -112,6 +126,9 @@ class StreamWorker:
 
     def offer(self, message: str) -> None:
         """One raw message through the topology."""
+        # chaos hook: lets a harness kill the worker at an exact stream
+        # position ("crash at the Nth offer") — one flag check when off
+        faults.failpoint("worker.offer")
         now_ms = int(self.clock() * 1000)
         try:
             uuid, point = self.formatter.format(message)
@@ -142,21 +159,68 @@ class StreamWorker:
             self._last_evict = now
             flushed = True
         if force or now - self._last_flush >= self.flush_interval_s:
-            self.anonymiser.punctuate()
+            self._flush_tiles()
             self._last_flush = now
             flushed = True
         if self.state is not None:
-            if flushed:
-                # tiles just egressed (an external side effect) — snapshot
-                # NOW, else a crash would restore and re-emit them
+            # tiles just egressed (an external side effect) — snapshot
+            # NOW, else a crash would restore and re-emit them. A failed
+            # snapshot degrades (wider replay window, counted) instead
+            # of killing the stream; the flush-epoch marker keeps the
+            # widened window duplicate-free.
+            try:
+                if flushed:
+                    self.state.save(self.batcher, self.anonymiser)
+                else:
+                    self.state.maybe_save(self.batcher, self.anonymiser)
+            except Exception as e:
+                metrics.count("state.save.fail")
+                logger.error("state snapshot failed (will retry): %s", e)
+
+    def _flush_tiles(self) -> None:
+        """Tile egress bracketed by durability barriers.
+
+        Pre-egress snapshot: the reports that fed this flush already
+        trimmed their batches; making those trims durable BEFORE the
+        tiles leave the process means a crash anywhere in the flush
+        cannot restore untrimmed batches that would re-report (and so
+        re-emit) segments the sink already has. Post-egress, the
+        committed-epoch marker lands AFTER the sink ack and BEFORE the
+        next snapshot, so restore can tell "flushed then crashed"
+        (skip the epoch) from "crashed mid-flush" (re-emit under the
+        same deterministic names — an overwrite, not a duplicate)."""
+        # the barrier only matters when something can actually egress —
+        # an idle interval must not pay a full fsync'd snapshot
+        if self.state is not None and self.anonymiser.slice_of:
+            try:
                 self.state.save(self.batcher, self.anonymiser)
-            else:
-                self.state.maybe_save(self.batcher, self.anonymiser)
+            except Exception as e:
+                metrics.count("state.save.fail")
+                logger.error("pre-flush snapshot failed (flushing "
+                             "anyway): %s", e)
+        epoch = self.anonymiser.flush_epoch
+        written = self.anonymiser.punctuate()
+        # chaos hook: THE window the flush-epoch machinery exists for —
+        # tiles at the sink, nothing durable about it yet
+        faults.failpoint("worker.post_egress")
+        # only a flush that fully reached the sink commits its epoch: a
+        # partial/failed egress leaves the marker behind so a restore
+        # retries the epoch (failed tiles are in the dead-letter spool
+        # either way), and an empty flush skips the fsync entirely
+        if self.state is not None and written > 0:
+            try:
+                self.state.commit_epoch(epoch)
+            except Exception as e:
+                # degraded: a restore would re-emit this epoch under the
+                # same deterministic names (overwrite, not duplicate)
+                metrics.count("state.epoch_commit.fail")
+                logger.error("flush-epoch commit failed for %d: %s",
+                             epoch, e)
 
     def drain(self) -> None:
         """End of stream: evict every open batch and flush all tiles."""
         self.batcher.punctuate(int(self.clock() * 1000) + 10 * self.session_gap_ms)
-        self.anonymiser.punctuate()
+        self._flush_tiles()
         if self.state is not None:
             self.state.save(self.batcher, self.anonymiser)
 
@@ -230,6 +294,12 @@ def main(argv=None):
                         help="durable state snapshot path; restored on "
                              "start, saved every --state-interval seconds")
     parser.add_argument("--state-interval", type=float, default=30.0)
+    parser.add_argument("--report-flush-interval", type=float, default=1.0,
+                        help="wall-clock bound (s) on how long a "
+                             "threshold-crossed session waits for a "
+                             "batched report flush; a huge value makes "
+                             "flush boundaries a pure function of the "
+                             "stream (deterministic replays/chaos runs)")
     parser.add_argument("--datastore",
                         help="local histogram-store directory: every "
                              "flushed tile is ALSO aggregated in-process "
@@ -314,19 +384,23 @@ def main(argv=None):
                    tee=tee),
         mode=args.mode, reports=args.reports, transitions=args.transitions,
         flush_interval_s=args.flush_interval, state=state,
-        uuid_filter=uuid_filter, submit_many=submit_many)
+        uuid_filter=uuid_filter, submit_many=submit_many,
+        report_flush_interval_s=args.report_flush_interval)
 
-    if args.bootstrap:
-        from .broker import KafkaBroker
-        broker = KafkaBroker(args.bootstrap)
-        raw_topic = (args.topics or "raw").split(",")[0]
-        messages = (value.decode() for _key, value in broker.consume(raw_topic))
-    elif args.input == "-":
-        messages = (line for line in sys.stdin)
-    else:
-        messages = open(args.input)
-
-    worker.run(messages, duration_s=args.duration)
+    # the flat-file input is opened under an ExitStack so the handle
+    # closes on every exit path (drain, exception, --duration cut-off)
+    with contextlib.ExitStack() as stack:
+        if args.bootstrap:
+            from .broker import KafkaBroker
+            broker = KafkaBroker(args.bootstrap)
+            raw_topic = (args.topics or "raw").split(",")[0]
+            messages = (value.decode()
+                        for _key, value in broker.consume(raw_topic))
+        elif args.input == "-":
+            messages = (line for line in sys.stdin)
+        else:
+            messages = stack.enter_context(open(args.input))
+        worker.run(messages, duration_s=args.duration)
     logger.info("Done: %d processed, %d parse failures",
                 worker.processed, worker.parse_failures)
     return 0

@@ -1,0 +1,119 @@
+"""Circuit breaker: bounded failure counting with a degraded mode.
+
+Classic three-state breaker (closed -> open -> half-open), built for the
+matcher's native-prep dispatch but generic: the protected operation asks
+:meth:`CircuitBreaker.allow` before each attempt and reports the outcome
+with :meth:`record_success` / :meth:`record_failure`.
+
+- closed: attempts allowed; ``threshold`` CONSECUTIVE failures open it.
+- open: attempts denied (callers take their degraded path) until
+  ``cooldown_s`` elapses, then the breaker half-opens.
+- half-open: exactly ONE probe attempt is admitted at a time; success
+  closes the breaker, failure re-opens it for another cooldown.
+
+Every transition and probe counts into the metrics registry under
+``{name}.*`` (``opened``/``closed``/``probes``/``failures``), so a
+/stats or /health reader sees the breaker working. Thread-safe: the
+matcher's device lanes, the service dispatch loop and direct Match()
+callers may all consult one instance.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable
+
+from . import metrics
+
+CLOSED = "closed"
+OPEN = "open"
+HALF_OPEN = "half_open"
+
+
+class CircuitBreaker:
+    def __init__(self, name: str, threshold: int = 5,
+                 cooldown_s: float = 30.0,
+                 clock: Callable[[], float] = time.monotonic,
+                 registry: metrics.Registry = metrics.default):
+        self.name = name
+        self.threshold = max(1, int(threshold))
+        self.cooldown_s = float(cooldown_s)
+        self._clock = clock
+        self._registry = registry
+        self._lock = threading.Lock()
+        self._state = CLOSED
+        self._failures = 0
+        self._opened_at = 0.0
+        self._probe_inflight = False
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            # an open breaker past its cooldown is *reported* half-open:
+            # the next allow() would admit a probe
+            if self._state == OPEN and \
+                    self._clock() - self._opened_at >= self.cooldown_s:
+                return HALF_OPEN
+            return self._state
+
+    def allow(self) -> bool:
+        """May the protected operation run right now? Open denies;
+        half-open admits one probe at a time."""
+        with self._lock:
+            if self._state == CLOSED:
+                return True
+            if self._state == OPEN:
+                if self._clock() - self._opened_at < self.cooldown_s:
+                    return False
+                self._state = HALF_OPEN
+                self._probe_inflight = False
+            if self._probe_inflight:
+                return False
+            self._probe_inflight = True
+        self._registry.count(f"{self.name}.probes")
+        return True
+
+    def record_success(self) -> None:
+        closed_now = False
+        with self._lock:
+            self._failures = 0
+            self._probe_inflight = False
+            if self._state != CLOSED:
+                self._state = CLOSED
+                closed_now = True
+        if closed_now:
+            self._registry.count(f"{self.name}.closed")
+
+    def record_failure(self) -> None:
+        opened_now = False
+        with self._lock:
+            self._probe_inflight = False
+            self._failures += 1
+            if self._state == HALF_OPEN or (
+                    self._state == CLOSED
+                    and self._failures >= self.threshold):
+                self._state = OPEN
+                self._opened_at = self._clock()
+                self._failures = 0
+                opened_now = True
+        self._registry.count(f"{self.name}.failures")
+        if opened_now:
+            self._registry.count(f"{self.name}.opened")
+
+    def snapshot(self) -> dict:
+        """State summary for /health."""
+        with self._lock:
+            state = self._state
+            failures = self._failures
+            remaining = 0.0
+            if state == OPEN:
+                remaining = max(
+                    0.0, self.cooldown_s - (self._clock() - self._opened_at))
+                if remaining == 0.0:
+                    state = HALF_OPEN
+        return {"state": state, "consecutive_failures": failures,
+                "threshold": self.threshold,
+                "cooldown_remaining_s": round(remaining, 3)}
+
+
+__all__ = ["CircuitBreaker", "CLOSED", "OPEN", "HALF_OPEN"]

@@ -1,0 +1,231 @@
+"""Deterministic named-failpoint injection.
+
+The reference swallows egress failures and loses in-flight state on
+crash (HttpClient.java:95-98, BatchingProcessor.java:20-22); this repo
+grew snapshots, dead-letter spools and retries piecemeal, but nothing
+could *prove* them — a failure you cannot reproduce is a failure you
+cannot test. This module is the proof substrate: named failpoints at the
+stage boundaries (``failpoint("native.prep")``, ``"egress.http"``,
+``"datastore.commit"``, ``"state.save"``, ``"matcher.submit"``,
+``"worker.offer"``, ``"worker.post_egress"``), armed by a spec string so
+a chaos run replays bit-identically, and costing ONE module-flag check
+when disabled — the hot paths carry the hooks permanently.
+
+Spec grammar (``REPORTER_TPU_FAULTS``, comma-separated)::
+
+    site=kind[:prob][@seed][#limit][+skip]
+
+    kind    error    raise FaultError before the effect runs
+            timeout  raise FaultTimeout (also a TimeoutError) before it
+            partial  the effect RUNS, then FaultError — simulates a
+                     committed-but-unacknowledged operation (the
+                     duplicate-risk window idempotency must absorb)
+            crash    os._exit(137) — an uncatchable SIGKILL-grade death
+    prob    fire probability per eligible call (default 1.0), drawn
+            from a per-site random.Random(seed) — replayable
+    seed    RNG seed (default 0)
+    limit   stop firing after this many fires (default unlimited) —
+            bounded storms that END, so recovery paths run
+    skip    ignore the first N eligible calls (default 0) — position a
+            deterministic fault mid-stream ("crash at the 501st offer")
+
+Examples::
+
+    native.prep=error@7#10        ten deterministic prep errors, then clean
+    egress.http=error:0.25@42     a flaky sink, 25% failures, replayable
+    worker.offer=crash+500#1      hard-exit exactly at the 501st offer
+
+Hook convention: ``failpoint(site)`` sits BEFORE the effect and fires
+error/timeout/crash; ``failpoint(site, after=True)`` sits after the
+effect but before its acknowledgement and fires only ``partial``. Sites
+wanting a crash *inside* a specific window get their own named
+before-hook there (``worker.post_egress``) — position lives in code,
+not in the grammar.
+
+Thread safety: arming (:func:`configure`) swaps the whole site table
+under ``_lock``; firing mutates only per-site counters under that
+site's own lock. ``failpoint`` reads the module flag lock-free — the
+disabled fast path is a single global load.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import random
+import re
+import sys
+import threading
+from typing import Dict, Optional
+
+logger = logging.getLogger("reporter_tpu.faults")
+
+ENV_VAR = "REPORTER_TPU_FAULTS"
+CRASH_EXIT_CODE = 137  # what a SIGKILL'd process reports (128 + 9)
+
+KINDS = ("error", "timeout", "partial", "crash")
+
+#: every failpoint site compiled into the framework today. The registry
+#: is open — new call sites need no central edit — but arming a site
+#: not listed here warns loudly: a typo'd spec must not silently run a
+#: faultless chaos scenario.
+KNOWN_SITES = frozenset({
+    "native.prep", "matcher.submit", "egress.http", "datastore.commit",
+    "state.save", "worker.offer", "worker.post_egress",
+})
+
+#: sites that place an ``after=True`` hook (the only position where
+#: kind=partial can fire); partial armed anywhere else warns.
+AFTER_HOOK_SITES = frozenset({"egress.http", "state.save"})
+
+_ENABLED = False
+_SITES: Dict[str, "_FailPoint"] = {}
+_SPEC: Optional[str] = None
+_lock = threading.Lock()
+
+
+class FaultError(RuntimeError):
+    """Raised by an armed ``error``/``partial`` failpoint."""
+
+
+class FaultTimeout(FaultError, TimeoutError):
+    """Raised by an armed ``timeout`` failpoint; catchable as either a
+    TimeoutError (realistic handling) or a FaultError (chaos harness)."""
+
+
+# suffixes after kind[:prob] may come in any order (#limit / +skip / @seed)
+_SPEC_RE = re.compile(
+    r"^(?P<site>[A-Za-z0-9_.\-]+)=(?P<kind>[a-z]+)"
+    r"(?::(?P<prob>[0-9.]+))?"
+    r"(?:@(?P<seed>\d+)|#(?P<limit>\d+)|\+(?P<skip>\d+)){0,3}$")
+
+
+class _FailPoint:
+    __slots__ = ("site", "kind", "prob", "seed", "limit", "skip",
+                 "rng", "fired", "seen", "lock")
+
+    def __init__(self, site: str, kind: str, prob: float, seed: int,
+                 limit: Optional[int], skip: int):
+        self.site = site
+        self.kind = kind
+        self.prob = prob
+        self.seed = seed
+        self.limit = limit
+        self.skip = skip
+        self.rng = random.Random(seed)
+        self.fired = 0
+        self.seen = 0
+        self.lock = threading.Lock()
+
+    def fire(self, after: bool) -> None:
+        # hook-position eligibility: partial only fires after the effect
+        # (committed-but-unacked); everything else fires before it
+        if (self.kind == "partial") != after:
+            return
+        with self.lock:
+            self.seen += 1
+            if self.seen <= self.skip:
+                return
+            if self.limit is not None and self.fired >= self.limit:
+                return
+            if self.prob < 1.0 and self.rng.random() >= self.prob:
+                return
+            self.fired += 1
+        if self.kind == "crash":
+            # uncatchable, no cleanup, no atexit — the closest a single
+            # process gets to SIGKILL while staying deterministic
+            sys.stderr.write(f"FAULT crash at {self.site}\n")
+            sys.stderr.flush()
+            os._exit(CRASH_EXIT_CODE)
+        if self.kind == "timeout":
+            raise FaultTimeout(f"injected timeout at {self.site}")
+        raise FaultError(f"injected {self.kind} at {self.site}")
+
+
+def parse_spec(spec: str) -> Dict[str, _FailPoint]:
+    """Parse a full spec string; raises ValueError on any malformed
+    entry (a typo'd fault spec must not silently run faultless chaos)."""
+    sites: Dict[str, _FailPoint] = {}
+    for entry in spec.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        m = _SPEC_RE.match(entry)
+        if m is None:
+            raise ValueError(f"bad fault spec entry {entry!r} "
+                             f"(want site=kind[:prob][@seed][#limit][+skip])")
+        kind = m.group("kind")
+        if kind not in KINDS:
+            raise ValueError(f"bad fault kind {kind!r} in {entry!r} "
+                             f"(one of {KINDS})")
+        prob = float(m.group("prob")) if m.group("prob") else 1.0
+        if not 0.0 <= prob <= 1.0:
+            raise ValueError(f"fault prob {prob} out of [0,1] in {entry!r}")
+        sites[m.group("site")] = _FailPoint(
+            m.group("site"), kind, prob,
+            int(m.group("seed") or 0),
+            int(m.group("limit")) if m.group("limit") else None,
+            int(m.group("skip") or 0))
+    return sites
+
+
+def configure(spec: Optional[str]) -> None:
+    """(Re)arm the failpoint table from a spec string; None/"" disarms.
+    Counters reset — a chaos scenario starts from a clean slate."""
+    global _ENABLED, _SITES, _SPEC
+    sites = parse_spec(spec) if spec else {}
+    with _lock:
+        _SITES = sites
+        _SPEC = spec if sites else None
+        _ENABLED = bool(sites)
+    if sites:
+        logger.warning("fault injection ARMED: %s", spec)
+        for site, fp in sites.items():
+            if site not in KNOWN_SITES:
+                logger.warning(
+                    "fault site %r is not a compiled-in failpoint "
+                    "(%s) — it will never fire unless some code calls "
+                    "failpoint(%r)", site, sorted(KNOWN_SITES), site)
+            elif fp.kind == "partial" and site not in AFTER_HOOK_SITES:
+                logger.warning(
+                    "fault site %r has no after-hook: kind=partial "
+                    "will never fire there (after-hook sites: %s)",
+                    site, sorted(AFTER_HOOK_SITES))
+
+
+def clear() -> None:
+    """Disarm every failpoint."""
+    configure(None)
+
+
+def failpoint(site: str, after: bool = False) -> None:
+    """The hook: zero-cost when disarmed (one module-flag check). May
+    raise :class:`FaultError`/:class:`FaultTimeout` or hard-exit the
+    process (kind=crash). ``after=True`` marks the committed-but-unacked
+    hook position (only ``partial`` fires there)."""
+    if not _ENABLED:
+        return
+    fp = _SITES.get(site)
+    if fp is not None:
+        fp.fire(after)
+
+
+def active_spec() -> Optional[str]:
+    """The armed spec string, or None — surfaced on /health."""
+    return _SPEC
+
+
+def fired_counts() -> Dict[str, int]:
+    """{site: times fired} for every armed site (chaos assertions)."""
+    return {site: fp.fired for site, fp in _SITES.items()}
+
+
+# arm from the environment at import: subprocess chaos scenarios set
+# REPORTER_TPU_FAULTS before exec. Malformed env must not brick every
+# import site — log loudly and stay disarmed (in-process callers use
+# configure(), which raises).
+_env_spec = os.environ.get(ENV_VAR)
+if _env_spec:
+    try:
+        configure(_env_spec)
+    except ValueError as _e:  # pragma: no cover - env typo path
+        logger.error("ignoring malformed %s=%r: %s", ENV_VAR, _env_spec, _e)

@@ -1,0 +1,305 @@
+"""Failure domains: the deterministic failpoint layer, the circuit
+breaker, the bounded batcher requeue, flush-epoch exactly-once egress,
+and the /health probe (ISSUE 5). The chaos harness (tools/chaos.py)
+drives the same mechanisms end-to-end; these tests pin each one in
+isolation."""
+import json
+import os
+
+import pytest
+
+from reporter_tpu.utils import faults, metrics
+from reporter_tpu.utils.circuit import CircuitBreaker
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    """Every test starts and ends with the fault table empty."""
+    faults.clear()
+    yield
+    faults.clear()
+
+
+class TestSpecParsing:
+    def test_full_grammar(self):
+        sites = faults.parse_spec(
+            "native.prep=error:0.5@7#10+3,egress.http=timeout")
+        fp = sites["native.prep"]
+        assert (fp.kind, fp.prob, fp.seed, fp.limit, fp.skip) == \
+            ("error", 0.5, 7, 10, 3)
+        fp = sites["egress.http"]
+        assert (fp.kind, fp.prob, fp.seed, fp.limit, fp.skip) == \
+            ("timeout", 1.0, 0, None, 0)
+
+    def test_suffixes_any_order(self):
+        a = faults.parse_spec("s=crash+669#1")["s"]
+        b = faults.parse_spec("s=crash#1+669")["s"]
+        assert (a.limit, a.skip) == (b.limit, b.skip) == (1, 669)
+
+    @pytest.mark.parametrize("bad", [
+        "nope", "site=explode", "site=error:2.0", "site=error:x",
+        "=error", "site=error@seed"])
+    def test_malformed_rejected(self, bad):
+        with pytest.raises(ValueError):
+            faults.parse_spec(bad)
+
+    def test_configure_and_clear(self):
+        faults.configure("a.b=error")
+        assert faults.active_spec() == "a.b=error"
+        faults.clear()
+        assert faults.active_spec() is None
+        faults.failpoint("a.b")  # disarmed: must not raise
+
+
+class TestFiring:
+    def test_disarmed_is_noop(self):
+        faults.failpoint("anything")
+
+    def test_unlisted_site_is_noop(self):
+        faults.configure("other=error")
+        faults.failpoint("this.one")
+
+    def test_error_raises_fault_error(self):
+        faults.configure("s=error")
+        with pytest.raises(faults.FaultError):
+            faults.failpoint("s")
+
+    def test_timeout_is_both_kinds(self):
+        faults.configure("s=timeout")
+        with pytest.raises(TimeoutError):
+            faults.failpoint("s")
+        with pytest.raises(faults.FaultError):
+            faults.failpoint("s")
+
+    def test_limit_bounds_the_storm(self):
+        faults.configure("s=error#2")
+        for _ in range(2):
+            with pytest.raises(faults.FaultError):
+                faults.failpoint("s")
+        faults.failpoint("s")  # spent
+        assert faults.fired_counts() == {"s": 2}
+
+    def test_skip_positions_the_fault(self):
+        faults.configure("s=error+3#1")
+        for _ in range(3):
+            faults.failpoint("s")
+        with pytest.raises(faults.FaultError):
+            faults.failpoint("s")
+        faults.failpoint("s")  # limit 1: one fire only
+
+    def test_probability_replays_bit_identically(self):
+        def run():
+            faults.configure("s=error:0.4@42")
+            fired = []
+            for i in range(50):
+                try:
+                    faults.failpoint("s")
+                    fired.append(False)
+                except faults.FaultError:
+                    fired.append(True)
+            return fired
+        a, b = run(), run()
+        assert a == b
+        assert any(a) and not all(a)
+
+    def test_partial_fires_only_after_hook(self):
+        faults.configure("s=partial")
+        faults.failpoint("s")  # before-hook: partial must not fire
+        with pytest.raises(faults.FaultError):
+            faults.failpoint("s", after=True)
+
+    def test_error_fires_only_before_hook(self):
+        faults.configure("s=error")
+        faults.failpoint("s", after=True)
+        with pytest.raises(faults.FaultError):
+            faults.failpoint("s")
+
+
+class TestCircuitBreaker:
+    def _breaker(self, **kw):
+        now = [0.0]
+        reg = metrics.Registry()
+        kw.setdefault("threshold", 3)
+        kw.setdefault("cooldown_s", 10.0)
+        cb = CircuitBreaker("test.circuit", clock=lambda: now[0],
+                            registry=reg, **kw)
+        return cb, now, reg
+
+    def test_opens_after_threshold_consecutive_failures(self):
+        cb, _now, reg = self._breaker()
+        for _ in range(2):
+            cb.record_failure()
+        assert cb.state == "closed" and cb.allow()
+        cb.record_failure()
+        assert cb.state == "open"
+        assert not cb.allow()
+        assert reg.snapshot()["counters"]["test.circuit.opened"] == 1
+
+    def test_success_resets_the_count(self):
+        cb, _now, _reg = self._breaker()
+        cb.record_failure()
+        cb.record_failure()
+        cb.record_success()
+        cb.record_failure()
+        cb.record_failure()
+        assert cb.state == "closed"
+
+    def test_half_open_admits_one_probe(self):
+        cb, now, reg = self._breaker()
+        for _ in range(3):
+            cb.record_failure()
+        assert not cb.allow()
+        now[0] = 10.0
+        assert cb.state == "half_open"
+        assert cb.allow()       # the probe
+        assert not cb.allow()   # only one at a time
+        cb.record_success()
+        assert cb.state == "closed" and cb.allow()
+        counters = reg.snapshot()["counters"]
+        assert counters["test.circuit.probes"] == 1
+        assert counters["test.circuit.closed"] == 1
+
+    def test_failed_probe_reopens_for_another_cooldown(self):
+        cb, now, _reg = self._breaker()
+        for _ in range(3):
+            cb.record_failure()
+        now[0] = 10.0
+        assert cb.allow()
+        cb.record_failure()
+        assert cb.state == "open"
+        assert not cb.allow()
+        now[0] = 19.9
+        assert not cb.allow()
+        now[0] = 20.0
+        assert cb.allow()
+
+    def test_snapshot_shape(self):
+        cb, now, _reg = self._breaker()
+        snap = cb.snapshot()
+        assert snap == {"state": "closed", "consecutive_failures": 0,
+                        "threshold": 3, "cooldown_remaining_s": 0.0}
+        for _ in range(3):
+            cb.record_failure()
+        now[0] = 4.0
+        snap = cb.snapshot()
+        assert snap["state"] == "open"
+        assert snap["cooldown_remaining_s"] == pytest.approx(6.0)
+
+
+class TestHealthAction:
+    @pytest.fixture(scope="class")
+    def city(self):
+        from reporter_tpu.synth import build_grid_city
+        return build_grid_city(rows=6, cols=6, spacing_m=200.0, seed=5,
+                               service_road_fraction=0.0,
+                               internal_fraction=0.0)
+
+    def test_healthy_service_reports_200(self, city):
+        from reporter_tpu.matcher import SegmentMatcher
+        from reporter_tpu.service.server import ReporterService
+        service = ReporterService(SegmentMatcher(net=city))
+        code, body = service.health()
+        body = json.loads(body)
+        assert code == 200
+        assert body["status"] == "ok"
+        assert body["graph"]["loaded"] and body["graph"]["edges"] > 0
+        assert body["native"]["status"] in ("native", "fallback")
+        assert body["circuit"]["state"] == "closed"
+        assert body["datastore"] == {"status": "absent"}
+        assert body["faults"] is None
+
+    def test_open_circuit_degrades_to_503(self, city):
+        from reporter_tpu.matcher import SegmentMatcher
+        from reporter_tpu.service.server import ReporterService
+        service = ReporterService(SegmentMatcher(net=city))
+        for _ in range(service.matcher.circuit.threshold):
+            service.matcher.circuit.record_failure()
+        code, body = service.health()
+        assert code == 503
+        assert json.loads(body)["status"] == "degraded"
+
+    def test_datastore_health(self, city, tmp_path):
+        from reporter_tpu.datastore import LocalDatastore
+        from reporter_tpu.matcher import SegmentMatcher
+        from reporter_tpu.service.server import ReporterService
+        ds = LocalDatastore(str(tmp_path / "store"))
+        service = ReporterService(SegmentMatcher(net=city), datastore=ds)
+        code, body = service.health()
+        assert code == 200
+        assert json.loads(body)["datastore"]["status"] == "ok"
+
+    def test_health_over_http(self, city):
+        import socket
+        import urllib.error
+        import urllib.request
+        from reporter_tpu.matcher import SegmentMatcher
+        from reporter_tpu.service.server import ReporterService, serve
+        service = ReporterService(SegmentMatcher(net=city))
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        httpd = serve(service, "127.0.0.1", port)
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/health", timeout=30) as r:
+                assert json.loads(r.read())["status"] == "ok"
+            for _ in range(service.matcher.circuit.threshold):
+                service.matcher.circuit.record_failure()
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/health", timeout=30)
+            assert exc.value.code == 503
+            assert json.loads(exc.value.read())["status"] == "degraded"
+        finally:
+            httpd.shutdown()
+
+
+class TestFailpointSites:
+    """The named sites actually sit where the docs say they sit."""
+
+    def test_state_save_failpoint(self, tmp_path):
+        from reporter_tpu.streaming.anonymiser import Anonymiser, TileSink
+        from reporter_tpu.streaming.batcher import PointBatcher
+        from reporter_tpu.streaming.state import StateStore
+        store = StateStore(str(tmp_path / "s.bin"))
+        b = PointBatcher(lambda t: None, lambda k, s: None)
+        a = Anonymiser(TileSink(str(tmp_path / "t")), privacy=1,
+                       quantisation=3600)
+        faults.configure("state.save=error")
+        with pytest.raises(faults.FaultError):
+            store.save(b, a)
+        assert not os.path.exists(str(tmp_path / "s.bin"))
+        faults.clear()
+        store.save(b, a)
+        assert os.path.exists(str(tmp_path / "s.bin"))
+
+    def test_datastore_commit_failpoint(self, tmp_path):
+        import numpy as np
+        from reporter_tpu.datastore import LocalDatastore
+        from reporter_tpu.datastore.schema import ObservationBatch
+        ds = LocalDatastore(str(tmp_path / "store"))
+        obs = ObservationBatch(
+            segment_id=np.array([1 << 25], dtype=np.int64),
+            next_id=np.array([2 << 25], dtype=np.int64),
+            duration_s=np.array([30.0]),
+            count=np.array([1], dtype=np.int64),
+            length_m=np.array([500], dtype=np.int64),
+            queue_m=np.array([0], dtype=np.int64),
+            min_ts=np.array([1500000000], dtype=np.int64),
+            max_ts=np.array([1500000030], dtype=np.int64))
+        faults.configure("datastore.commit=error")
+        with pytest.raises(faults.FaultError):
+            ds.ingest(obs)
+        faults.clear()
+        assert ds.ingest(obs) == 1
+
+    def test_egress_partial_spools_despite_committed_write(self, tmp_path):
+        """kind=partial: the tile REACHES the file sink, yet the caller
+        sees failure and spools — the committed-but-unacked window."""
+        from reporter_tpu.streaming.anonymiser import TileSink
+        sink = TileSink(str(tmp_path / "out"))
+        faults.configure("egress.http=partial")
+        assert sink.store("1_2/0/1", "f", "payload") is False
+        assert (tmp_path / "out" / "1_2" / "0" / "1" / "f").exists()
+        assert (tmp_path / "out" / ".deadletter" / "1_2" / "0" / "1"
+                / "f").exists()

@@ -352,7 +352,10 @@ class TestDevicePipeline:
         native path a malformed trace raises before any submit (the
         length bucketing walks all traces first), so inject the failure
         into prep of a LATER chunk instead — earlier chunks are already
-        on the lanes when it propagates."""
+        on the lanes when it propagates. A native prep failure alone now
+        degrades that chunk to the numpy fallback (the circuit-breaker
+        failure domain), so BOTH prep paths must fail for the error to
+        reach the caller."""
         import reporter_tpu.matcher.matcher as mod
 
         monkeypatch.setenv("REPORTER_TPU_DECODE_CHUNK", "2")
@@ -367,13 +370,51 @@ class TestDevicePipeline:
                 raise RuntimeError("prep exploded")
             return real(*a, **kw)
 
+        def numpy_boom(*a, **kw):
+            raise RuntimeError("prep exploded in fallback too")
+
         monkeypatch.setattr(mod, "prepare_batch", flaky)
+        monkeypatch.setattr(mod, "prepare_traces_numpy", numpy_boom)
         with pytest.raises(RuntimeError, match="prep exploded"):
             m.match_many(reqs)
         assert calls["n"] == 2, "failure must hit with a chunk in flight"
-        monkeypatch.setattr(mod, "prepare_batch", real)
+        monkeypatch.undo()
+        monkeypatch.setenv("REPORTER_TPU_DECODE_CHUNK", "2")
         after = m.match_many(reqs)
         assert all(r and r["segments"] for r in after)
+
+    def test_native_prep_failure_degrades_to_fallback(self, city,
+                                                      monkeypatch):
+        """One flaky native chunk no longer fails the whole call: the
+        chunk is served through the numpy path, results stay complete
+        and identical, and the breaker counts one failure."""
+        import reporter_tpu.matcher.matcher as mod
+
+        monkeypatch.setenv("REPORTER_TPU_DECODE_CHUNK", "2")
+        m = SegmentMatcher(net=city)
+        if m.runtime is None:
+            pytest.skip("native runtime unavailable")
+        reqs = self._reqs(city)
+        want = [dict(r) for r in m.match_many(reqs)]
+        calls = {"n": 0}
+        real = mod.prepare_batch
+
+        def flaky(*a, **kw):
+            calls["n"] += 1
+            if calls["n"] == 2:
+                raise RuntimeError("prep exploded")
+            return real(*a, **kw)
+
+        monkeypatch.setattr(mod, "prepare_batch", flaky)
+        got = m.match_many(reqs)
+        assert calls["n"] >= 2
+        assert [dict(r) for r in got] == want
+        assert m.circuit.snapshot()["state"] == "closed", \
+            "one flake must not open the circuit"
+        monkeypatch.setattr(mod, "prepare_batch", real)
+        m.match_many(reqs)
+        assert m.circuit.snapshot()["consecutive_failures"] == 0, \
+            "a clean native chunk must reset the failure count"
 
     def test_concurrent_match_many_callers_share_lanes(self, city,
                                                        monkeypatch):

@@ -10,6 +10,8 @@ evicted sessions as one padded device batch. Pinned here:
   SegmentMatcher.match_many as one N-trace batch;
 - per-uuid trim/forward semantics survive the batched path.
 """
+import os
+
 import numpy as np
 import pytest
 
@@ -204,7 +206,7 @@ def test_midstream_reports_flush_as_one_batch():
                      lambda k, s: None,
                      submit_many=lambda bodies:
                      calls.append([t["uuid"] for t in bodies])
-                     or [None] * len(bodies))
+                     or [{"shape_used": 0} for _ in bodies])
     for j in range(4):
         _feed_big_session(b, f"veh-{j}", t0=1000)
     assert not single_calls, "mid-stream reports must not fire at batch=1"
@@ -212,9 +214,61 @@ def test_midstream_reports_flush_as_one_batch():
     b.flush_pending()
     assert [sorted(c) for c in calls] == [[f"veh-{j}" for j in range(4)]]
     assert not b.pending
-    # a None response (failed round trip) drops the batch, reference
-    # semantics — the sessions are gone from the store
-    assert all(not batch.points for batch in b.store.values())
+    # shape_used 0: nothing consumed, the sessions keep their context
+    assert all(batch.points for batch in b.store.values())
+
+
+def test_failed_midstream_flush_requeues_not_drops():
+    """A failed round trip no longer silently drops live sessions
+    (the reference's Batch.java:83-87 behavior): the batch requeues
+    under the retry budget with its points intact."""
+    b = PointBatcher(lambda body: None, lambda k, s: None,
+                     submit_many=lambda bodies: [None] * len(bodies),
+                     retry_budget=2)
+    for j in range(4):
+        _feed_big_session(b, f"veh-{j}", t0=1000)
+    b.flush_pending()
+    assert sorted(b.pending) == [f"veh-{j}" for j in range(4)]
+    assert all(batch.points for batch in b.store.values())
+    assert all(batch.retries == 1 for batch in b.store.values())
+
+
+def test_exhausted_budget_deadletters_trace_json(tmp_path):
+    """Retries spent: the trace JSON spools for replay (batch.dropped +
+    batch.deadletter), the batch empties, and the next window gets a
+    fresh budget."""
+    import json
+    spool = str(tmp_path / "spool")
+    b = PointBatcher(lambda body: None, lambda k, s: None,
+                     submit_many=lambda bodies: [None] * len(bodies),
+                     retry_budget=1, deadletter_dir=spool)
+    _feed_big_session(b, "veh-0", t0=1000)
+    b.flush_pending()          # failure 1: requeued (budget 1)
+    assert b.store["veh-0"].retries == 1
+    b.flush_pending()          # failure 2: budget spent -> dead-letter
+    assert not b.store["veh-0"].points
+    assert b.store["veh-0"].retries == 0
+    names = sorted(os.listdir(spool))
+    assert len(names) == 1 and names[0].endswith(".veh-0.json")
+    with open(os.path.join(spool, names[0])) as f:
+        body = json.load(f)
+    assert body["uuid"] == "veh-0"
+    assert len(body["trace"]) >= 10
+    assert body["match_options"]["report_levels"] == [0, 1]
+
+
+def test_evicted_batch_failure_deadletters_immediately(tmp_path):
+    """An evicted session has no next flush to ride — a failed submit
+    dead-letters it instead of requeueing a ghost."""
+    spool = str(tmp_path / "spool")
+    b = PointBatcher(lambda body: None, lambda k, s: None,
+                     submit_many=lambda bodies: [None] * len(bodies),
+                     retry_budget=5, deadletter_dir=spool)
+    _feed_session(b, "veh-gone", t0=1000)
+    b.punctuate(stream_time_ms=10_000_000)
+    assert "veh-gone" not in b.store
+    assert not b.pending
+    assert any(".veh-gone." in n for n in os.listdir(spool))
 
 
 def test_pending_flush_trims_consumed_prefix():

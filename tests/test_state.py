@@ -32,8 +32,11 @@ class TestSnapshotRoundtrip:
         batch = Batch(Point(lat=14.6, lon=121.0, accuracy=10, time=100))
         batch.update(Point(lat=14.61, lon=121.01, accuracy=12, time=160))
         batch.last_update = 160000
+        batch.retries = 1
         b.store["veh-1"] = batch
+        b.pending["veh-1"] = None
         a.process("1 2", _seg())
+        a.flush_epoch = 7
         assert a.slices and a.slice_of
 
         b2, a2 = _batcher(), _anonymiser(tmp_path)
@@ -41,12 +44,25 @@ class TestSnapshotRoundtrip:
         assert set(b2.store) == {"veh-1"}
         got = b2.store["veh-1"]
         assert got.last_update == 160000
+        assert got.retries == 1
         assert got.max_separation == pytest.approx(batch.max_separation)
         assert [p.to_bytes() for p in got.points] == \
             [p.to_bytes() for p in batch.points]
+        assert list(b2.pending) == ["veh-1"]
         assert {k: [s.to_bytes() for s in v] for k, v in a2.slices.items()} \
             == {k: [s.to_bytes() for s in v] for k, v in a.slices.items()}
         assert a2.slice_of == a.slice_of
+        assert a2.flush_epoch == 7
+
+    def test_points_roundtrip_losslessly(self):
+        """The f32 wire format is the value domain: a restored point is
+        bit-equal to its never-snapshotted twin (crash/restore output
+        parity depends on this — chaos kill_restore scenario)."""
+        p = Point(lat=14.600001234, lon=121.0000056789, accuracy=10,
+                  time=100)
+        q = Point.from_bytes(p.to_bytes())
+        assert (q.lat, q.lon, q.accuracy, q.time) == \
+            (p.lat, p.lon, p.accuracy, p.time)
 
     def test_empty_state_roundtrips(self, tmp_path):
         b, a = _batcher(), _anonymiser(tmp_path)
@@ -88,6 +104,32 @@ class TestStateStore:
         # clean-discard semantics: nothing half-restored is left behind
         assert not b2.store and not a2.slices and not a2.slice_of
 
+    def test_marker_survives_lost_snapshot_and_seeds_epoch(self, tmp_path):
+        """A dead snapshot with a live .epoch marker must not restart
+        tile numbering at 0 — epoch-named files up to the marker are
+        committed at the sink and would be overwritten with new data."""
+        store = StateStore(str(tmp_path / "state.bin"))
+        store.commit_epoch(4)
+        b, a = _batcher(), _anonymiser(tmp_path)
+        assert store.restore(b, a) is False
+        assert a.flush_epoch == 5
+        # corrupt snapshot path seeds identically
+        (tmp_path / "state.bin").write_bytes(b"RTS1garbage")
+        b2, a2 = _batcher(), _anonymiser(tmp_path)
+        assert StateStore(str(tmp_path / "state.bin")).restore(b2, a2) \
+            is False
+        assert a2.flush_epoch == 5
+
+    def test_v1_snapshot_discarded_as_no_snapshot(self, tmp_path):
+        """A pre-epoch (v1) snapshot predates the exactly-once machinery:
+        it is discarded like corruption, not half-interpreted."""
+        import struct
+        path = tmp_path / "state.bin"
+        path.write_bytes(struct.pack("<4sIQ", b"RTS1", 1, 0)
+                         + struct.pack("<I", 0) * 3)
+        assert StateStore(str(path)).restore(
+            _batcher(), _anonymiser(tmp_path)) is False
+
     def test_maybe_save_respects_interval(self, tmp_path):
         now = [0.0]
         store = StateStore(str(tmp_path / "s.bin"), interval_s=30.0,
@@ -97,6 +139,123 @@ class TestStateStore:
         now[0] = 31.0
         assert store.maybe_save(b, a) is True
         assert store.maybe_save(b, a) is False
+
+
+class TestFlushEpochExactlyOnce:
+    """The crash-between-egress-and-snapshot window (ISSUE 5): tiles
+    reached the sink, the committed-epoch marker landed, the snapshot
+    did NOT — restore must skip the epoch instead of double-emitting."""
+
+    def _tiles(self, out):
+        import os
+        return sorted(os.path.join(r, f)
+                      for r, _d, fs in os.walk(out)
+                      for f in fs if ".deadletter" not in r)
+
+    def test_crash_after_commit_before_save_skips_epoch(self, tmp_path):
+        out = tmp_path / "tiles"
+        path = str(tmp_path / "state.bin")
+        b, a = _batcher(), Anonymiser(TileSink(str(out)), privacy=1,
+                                      quantisation=3600)
+        a.process("1 2", _seg())
+        store = StateStore(path)
+        store.save(b, a)                      # pre-flush snapshot: epoch 0
+        epoch = a.flush_epoch
+        assert a.punctuate() == 1             # tiles egress as epoch 0
+        store.commit_epoch(epoch)             # durable "epoch 0 done"
+        tiles = self._tiles(out)
+        assert len(tiles) == 1 and tiles[0].endswith(".e00000000")
+        # CRASH here: store.save never runs
+
+        b2, a2 = _batcher(), Anonymiser(TileSink(str(out)), privacy=1,
+                                        quantisation=3600)
+        assert StateStore(path).restore(b2, a2) is True
+        assert not a2.slices and not a2.slice_of, \
+            "already-egressed slices must be skipped on restore"
+        assert a2.flush_epoch == 1
+        a2.punctuate()                        # must be a no-op
+        assert self._tiles(out) == tiles, "no duplicate tiles"
+
+    def test_crash_before_commit_reemits_same_file_name(self, tmp_path):
+        """The other half of the window: egress done (or half-done) but
+        the marker missing — restore re-emits epoch 0 under the SAME
+        deterministic name, so the file sink overwrites byte-identically
+        instead of duplicating (the reference's uuid4 names duplicated)."""
+        out = tmp_path / "tiles"
+        path = str(tmp_path / "state.bin")
+        b, a = _batcher(), Anonymiser(TileSink(str(out)), privacy=1,
+                                      quantisation=3600)
+        a.process("1 2", _seg())
+        StateStore(path).save(b, a)
+        a.punctuate()                         # tiles hit the sink...
+        before = self._tiles(out)
+        # ...CRASH before commit_epoch and save
+
+        b2, a2 = _batcher(), Anonymiser(TileSink(str(out)), privacy=1,
+                                        quantisation=3600)
+        assert StateStore(path).restore(b2, a2) is True
+        assert a2.slices and a2.flush_epoch == 0
+        assert a2.punctuate() == 1            # re-emit, same epoch
+        after = self._tiles(out)
+        assert after == before, "re-emit must overwrite, not duplicate"
+
+    def test_pre_egress_barrier_makes_report_trims_durable(self, tmp_path):
+        """The three-step flush protocol's step 1: the snapshot taken
+        BEFORE egress carries the report trims and the emptied pending
+        set, so a crash after commit_epoch cannot restore untrimmed
+        batches that would re-report (and re-emit) segments the sink
+        already has."""
+        response = {"shape_used": 5, "datastore": {"reports": [{
+            "id": 1, "next_id": 2, "t0": 1000.0, "t1": 1030.0,
+            "length": 500, "queue_length": 0}]}}
+        out = tmp_path / "tiles"
+        a = Anonymiser(TileSink(str(out)), privacy=1, quantisation=3600)
+        b = PointBatcher(lambda t: None,
+                         lambda key, seg: a.process(key, seg),
+                         submit_many=lambda tb: [response] * len(tb))
+        for i in range(12):
+            b.process("veh", Point(lat=14.6 + i * 0.001, lon=121.0,
+                                   accuracy=10, time=1000 + i * 10),
+                      stream_time_ms=(1000 + i * 10) * 1000)
+        assert "veh" in b.pending
+        # the worker's _flush_tiles sequence, crashing before the
+        # post-flush save:
+        b.flush_pending()                     # reports fire, batch trims
+        assert len(b.store["veh"].points) == 7
+        store = StateStore(str(tmp_path / "state.bin"))
+        store.save(b, a)                      # step 1: pre-egress barrier
+        epoch = a.flush_epoch
+        assert a.punctuate() == 1             # step 2: egress
+        store.commit_epoch(epoch)             # step 3: marker
+        # CRASH — post-flush save never runs
+
+        a2 = Anonymiser(TileSink(str(out)), privacy=1, quantisation=3600)
+        b2 = PointBatcher(lambda t: None, lambda k, s: None)
+        assert StateStore(str(tmp_path / "state.bin")).restore(b2, a2)
+        assert not a2.slices, "egressed slices skipped"
+        assert len(b2.store["veh"].points) == 7, \
+            "restored batch must carry the trim, not the full window"
+        assert not b2.pending, "consumed report must not be re-pending"
+
+    def test_normal_flush_then_save_does_not_skip(self, tmp_path):
+        out = tmp_path / "tiles"
+        path = str(tmp_path / "state.bin")
+        b, a = _batcher(), Anonymiser(TileSink(str(out)), privacy=1,
+                                      quantisation=3600)
+        a.process("1 2", _seg())
+        store = StateStore(path)
+        epoch = a.flush_epoch
+        a.punctuate()
+        store.commit_epoch(epoch)
+        store.save(b, a)                      # the healthy ordering
+        a.process("1 2", _seg(t0=2000.0))     # new post-flush state
+        store.save(b, a)
+
+        b2, a2 = _batcher(), Anonymiser(TileSink(str(out)), privacy=1,
+                                        quantisation=3600)
+        assert StateStore(path).restore(b2, a2) is True
+        assert a2.slices, "post-flush slices must survive restore"
+        assert a2.flush_epoch == 1
 
 
 class TestWorkerCrashResume:

@@ -1,0 +1,422 @@
+#!/usr/bin/env python
+"""Chaos harness: scripted failure scenarios over the REAL stack.
+
+Every robustness mechanism in this repo is provable, or it is a story:
+the deterministic failpoint layer (reporter_tpu/utils/faults.py) arms
+named faults with seeded specs, and each scenario below replays a
+synthetic stream under one failure domain and asserts the defined
+degraded behavior — including *output parity* against a fault-free run
+where the mechanism promises it.
+
+Scenarios (run the named ones, default ``storm kill_restore``):
+
+  storm         native prep error storm -> circuit breaker OPENS ->
+                chunks served via the numpy fallback BYTE-IDENTICALLY ->
+                cooldown -> half-open probe -> circuit re-closes
+  kill_restore  crash failpoint (os._exit 137, SIGKILL-grade) at an
+                exact mid-stream offer -> restart -> snapshot restore ->
+                tile output byte-identical to a fault-free run (no lost
+                reports beyond the snapshot window, no duplicate tiles)
+  submit_burst  matcher 5xx burst -> bounded requeue under the retry
+                budget -> recovery without loss; a dead matcher ->
+                trace-JSON dead-letter spool instead of silent drops
+  egress_outage sink down -> every tile dead-letters -> `datastore
+                ingest --delete` replay -> histogram datastore parity
+                with a fault-free run
+
+Usage:
+  REPORTER_TPU_PLATFORM=cpu python tools/chaos.py [scenario ...]
+  (``all`` runs every scenario; REPORTER_TPU_CHAOS_REQUIRE_NATIVE=1
+  makes a missing native runtime a failure instead of a skip — CI sets
+  it so the storm scenario can never silently stop testing the breaker)
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("REPORTER_TPU_PLATFORM", "cpu")  # never probe a chip
+
+FMT = r",sv,\|,0,1,2,3,4"  # uuid|lat|lon|time|accuracy
+
+
+def log(msg: str) -> None:
+    print(f"chaos: {msg}", flush=True)
+
+
+def fail(msg: str) -> int:
+    sys.stderr.write(f"chaos: FAIL: {msg}\n")
+    return 1
+
+
+def _city():
+    from reporter_tpu.synth import build_grid_city
+    return build_grid_city(rows=10, cols=10, spacing_m=200.0, seed=5,
+                           service_road_fraction=0.0, internal_fraction=0.0)
+
+
+def _lines(city, n_traces: int, seed: int = 9):
+    import numpy as np
+    from reporter_tpu.synth import generate_trace
+    rng = np.random.default_rng(seed)
+    lines = []
+    for i in range(n_traces):
+        tr = None
+        while tr is None:
+            tr = generate_trace(city, f"veh-{i}", rng, noise_m=3.0,
+                                min_route_edges=8)
+        for p in tr.points:
+            lines.append("|".join([tr.uuid, str(p["lat"]), str(p["lon"]),
+                                   str(p["time"]), str(p["accuracy"])]))
+    return lines
+
+
+def _make_worker(city, out_dir: str, state_path=None,
+                 report_flush_interval_s: float = 1e9):
+    from reporter_tpu.matcher import SegmentMatcher
+    from reporter_tpu.service.server import ReporterService
+    from reporter_tpu.streaming.anonymiser import Anonymiser, TileSink
+    from reporter_tpu.streaming.formatter import Formatter
+    from reporter_tpu.streaming.state import StateStore
+    from reporter_tpu.streaming.worker import StreamWorker, inproc_submitter
+
+    service = ReporterService(SegmentMatcher(net=city), threshold_sec=15,
+                              max_batch=64, max_wait_ms=5.0)
+    return StreamWorker(
+        Formatter.from_config(FMT), inproc_submitter(service),
+        Anonymiser(TileSink(out_dir), privacy=1, quantisation=3600,
+                   source="chaos"),
+        reports="0,1,2", transitions="0,1,2", flush_interval_s=1e9,
+        state=StateStore(state_path, interval_s=0.0) if state_path else None,
+        submit_many=service.report_many,
+        report_flush_interval_s=report_flush_interval_s)
+
+
+def _tile_tree(root: str) -> dict:
+    """{relpath: bytes} of every tile file under a sink dir (spools
+    excluded) — the byte-parity comparand."""
+    out = {}
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(d for d in dirnames
+                             if d not in (".deadletter", ".traces"))
+        for name in sorted(filenames):
+            path = os.path.join(dirpath, name)
+            with open(path, "rb") as f:
+                out[os.path.relpath(path, root)] = f.read()
+    return out
+
+
+def _as_plain(result) -> dict:
+    """A match result (dict or lazy MatchRuns) as a canonical dict."""
+    return {"segments": [dict(s) for s in result["segments"]],
+            "mode": result["mode"]}
+
+
+# ---------------------------------------------------------------------------
+def scenario_storm() -> int:
+    """Native error storm: circuit opens, fallback serves byte-identical
+    results, cooldown passes, a probe re-closes the circuit."""
+    from reporter_tpu import native
+    from reporter_tpu.matcher import SegmentMatcher
+    from reporter_tpu.utils import faults, metrics
+
+    if not native.available():
+        if os.environ.get("REPORTER_TPU_CHAOS_REQUIRE_NATIVE"):
+            return fail("native runtime unavailable but required")
+        log("storm SKIPPED (native runtime unavailable)")
+        return 0
+
+    # cooldown sized so storm calls land well inside it on a slow box
+    # (a probe slipping in mid-storm just fails and re-opens, but every
+    # probed chunk is one not counted as short-circuited)
+    os.environ["REPORTER_TPU_CIRCUIT_THRESHOLD"] = "3"
+    os.environ["REPORTER_TPU_CIRCUIT_COOLDOWN_S"] = "3.0"
+    try:
+        import numpy as np
+        from reporter_tpu.synth import generate_trace
+        city = _city()
+        matcher = SegmentMatcher(net=city)
+        if matcher.runtime is None:
+            return fail("native runtime did not attach")
+        rng = np.random.default_rng(11)
+        reqs = []
+        for i in range(8):
+            tr = None
+            while tr is None:
+                tr = generate_trace(city, f"storm-{i}", rng, noise_m=3.0,
+                                    min_route_edges=8)
+            reqs.append({"uuid": tr.uuid, "trace": tr.points,
+                         "match_options": {"mode": "auto",
+                                           "report_levels": [0, 1, 2],
+                                           "transition_levels": [0, 1, 2]}})
+
+        # fault-free reference results through the native path
+        want = [_as_plain(r) for r in matcher.match_many(reqs)]
+        metrics.default.reset()
+
+        # the storm: every native prep errors until the circuit trips
+        # (seeded, prob 1 — replays bit-identically); no fire limit, the
+        # breaker itself must stop the bleeding
+        faults.configure("native.prep=error@0")
+        stormed = []
+        for _ in range(5):
+            stormed.append([_as_plain(r) for r in matcher.match_many(reqs)])
+        snap = metrics.default.snapshot()["counters"]
+        if matcher.circuit.snapshot()["state"] not in ("open", "half_open"):
+            return fail(f"circuit did not open: {matcher.circuit.snapshot()}")
+        if not snap.get("matcher.circuit.opened"):
+            return fail(f"no open transition counted: {snap}")
+        if not snap.get("matcher.circuit.fallback_chunks"):
+            return fail(f"no chunk was short-circuited to the fallback: "
+                        f"{snap}")
+        for got in stormed:
+            if got != want:
+                return fail("fallback results diverged from the "
+                            "fault-free native run")
+        log(f"storm: circuit opened after "
+            f"{snap.get('matcher.circuit.native_errors', 0)} native "
+            f"errors, {snap.get('matcher.circuit.fallback_chunks')} "
+            f"chunks served degraded, results byte-identical")
+
+        # recovery: faults gone, cooldown elapses, one probe re-closes
+        faults.clear()
+        time.sleep(3.2)
+        after = [_as_plain(r) for r in matcher.match_many(reqs)]
+        snap = metrics.default.snapshot()["counters"]
+        if matcher.circuit.snapshot()["state"] != "closed":
+            return fail(f"circuit did not re-close: "
+                        f"{matcher.circuit.snapshot()}")
+        if not snap.get("matcher.circuit.probes") \
+                or not snap.get("matcher.circuit.closed"):
+            return fail(f"no half-open probe/close recorded: {snap}")
+        if after != want:
+            return fail("post-recovery results diverged")
+        log(f"storm ok: probe re-closed the circuit "
+            f"(probes={snap['matcher.circuit.probes']})")
+        return 0
+    finally:
+        faults.clear()
+        os.environ.pop("REPORTER_TPU_CIRCUIT_THRESHOLD", None)
+        os.environ.pop("REPORTER_TPU_CIRCUIT_COOLDOWN_S", None)
+
+
+# ---------------------------------------------------------------------------
+def scenario_kill_restore() -> int:
+    """SIGKILL-grade crash mid-stream, restart, restore: tile output must
+    be byte-identical to an uninterrupted run."""
+    from reporter_tpu.utils import faults as faults_mod
+
+    with tempfile.TemporaryDirectory() as tmp:
+        city = _city()
+        graph = os.path.join(tmp, "city.npz")
+        city.save(graph)
+        lines = _lines(city, n_traces=8)
+        k = len(lines) // 2
+        full = os.path.join(tmp, "full.txt")
+        tail = os.path.join(tmp, "tail.txt")
+        with open(full, "w") as f:
+            f.write("\n".join(lines) + "\n")
+        with open(tail, "w") as f:
+            f.write("\n".join(lines[k:]) + "\n")
+
+        def cmd(inp, out, state):
+            return [sys.executable, "-m", "reporter_tpu", "stream",
+                    "-f", FMT, "--graph", graph, "-p", "1", "-q", "3600",
+                    "-i", "1000000000", "-s", "chaos", "-o", out,
+                    "--input", inp, "--state-file", state,
+                    "--state-interval", "0", "--uuid-filter", "off",
+                    "-r", "0,1,2", "-x", "0,1,2",
+                    "--report-flush-interval", "1000000000"]
+
+        env = dict(os.environ, REPORTER_TPU_PLATFORM="cpu")
+        env.pop("REPORTER_TPU_FAULTS", None)
+
+        out_ref = os.path.join(tmp, "ref")
+        log(f"kill_restore: fault-free run over {len(lines)} probes")
+        p = subprocess.run(cmd(full, out_ref, os.path.join(tmp, "s_ref")),
+                           env=env, cwd=REPO, capture_output=True,
+                           text=True, timeout=600)
+        if p.returncode != 0:
+            return fail(f"fault-free run rc={p.returncode}: "
+                        f"{p.stderr[-2000:]}")
+
+        out_chaos = os.path.join(tmp, "chaos")
+        state = os.path.join(tmp, "s_chaos")
+        log(f"kill_restore: crashing at offer {k + 1}")
+        env_crash = dict(env,
+                         REPORTER_TPU_FAULTS=f"worker.offer=crash+{k}#1")
+        p = subprocess.run(cmd(full, out_chaos, state), env=env_crash,
+                           cwd=REPO, capture_output=True, text=True,
+                           timeout=600)
+        if p.returncode != faults_mod.CRASH_EXIT_CODE:
+            return fail(f"crash run rc={p.returncode} "
+                        f"(want {faults_mod.CRASH_EXIT_CODE}): "
+                        f"{p.stderr[-2000:]}")
+        if not os.path.exists(state):
+            return fail("no state snapshot survived the crash")
+
+        log("kill_restore: restarting from the snapshot")
+        p = subprocess.run(cmd(tail, out_chaos, state), env=env, cwd=REPO,
+                           capture_output=True, text=True, timeout=600)
+        if p.returncode != 0:
+            return fail(f"restore run rc={p.returncode}: "
+                        f"{p.stderr[-2000:]}")
+        if "Restored state" not in p.stderr:
+            return fail("restore run did not restore the snapshot")
+
+        ref, got = _tile_tree(out_ref), _tile_tree(out_chaos)
+        if not ref:
+            return fail("fault-free run wrote no tiles")
+        if got != ref:
+            only_ref = sorted(set(ref) - set(got))
+            only_got = sorted(set(got) - set(ref))
+            differ = sorted(k for k in set(ref) & set(got)
+                            if ref[k] != got[k])
+            return fail(f"tile trees diverge: missing={only_ref[:5]} "
+                        f"extra={only_got[:5]} differ={differ[:5]}")
+        log(f"kill_restore ok: {len(ref)} tile files byte-identical "
+            f"across crash+restore")
+        return 0
+
+
+# ---------------------------------------------------------------------------
+def scenario_submit_burst() -> int:
+    """Transient matcher failures requeue under the budget and recover;
+    a dead matcher dead-letters trace JSON instead of dropping."""
+    from reporter_tpu.utils import faults, metrics
+
+    with tempfile.TemporaryDirectory() as tmp:
+        city = _city()
+        lines = _lines(city, n_traces=4)
+
+        # part 1: a 2-failure burst (within the default budget of 2)
+        metrics.default.reset()
+        out = os.path.join(tmp, "burst")
+        worker = _make_worker(city, out, report_flush_interval_s=0.0)
+        faults.configure("matcher.submit=error@0#2")
+        try:
+            worker.run(iter(lines))
+        finally:
+            faults.clear()
+        snap = metrics.default.snapshot()["counters"]
+        if not snap.get("batch.requeued"):
+            return fail(f"burst did not requeue: {snap}")
+        if snap.get("batch.dropped"):
+            return fail(f"burst within budget still dropped: {snap}")
+        if not _tile_tree(out):
+            return fail("no tiles written after requeue recovery")
+        log(f"submit_burst: {snap['batch.requeued']} requeues, 0 drops, "
+            f"tiles written after recovery")
+
+        # part 2: the matcher stays dead — budget exhausts, trace JSON
+        # dead-letters, the stream itself survives
+        metrics.default.reset()
+        out2 = os.path.join(tmp, "dead")
+        worker = _make_worker(city, out2, report_flush_interval_s=0.0)
+        faults.configure("matcher.submit=error@0")
+        try:
+            worker.run(iter(lines))
+        finally:
+            faults.clear()
+        snap = metrics.default.snapshot()["counters"]
+        if not snap.get("batch.dropped") or not snap.get("batch.deadletter"):
+            return fail(f"dead matcher did not dead-letter: {snap}")
+        spool = worker.batcher.deadletter_dir
+        names = sorted(os.listdir(spool)) if os.path.isdir(spool) else []
+        if not names:
+            return fail("no trace JSON in the dead-letter spool")
+        with open(os.path.join(spool, names[0]), encoding="utf-8") as f:
+            body = json.load(f)
+        if not body.get("uuid") or not body.get("trace"):
+            return fail(f"unreplayable dead-letter body: {body}")
+        log(f"submit_burst ok: dead matcher -> {len(names)} trace(s) "
+            f"spooled for replay, stream survived")
+        return 0
+
+
+# ---------------------------------------------------------------------------
+def scenario_egress_outage() -> int:
+    """Sink outage: every tile dead-letters; `datastore ingest --delete`
+    replays the spool into a store that matches a fault-free run's."""
+    from reporter_tpu.datastore import LocalDatastore, ingest_dir
+    from reporter_tpu.utils import faults, metrics
+
+    with tempfile.TemporaryDirectory() as tmp:
+        city = _city()
+        lines = _lines(city, n_traces=6)
+
+        metrics.default.reset()
+        out = os.path.join(tmp, "out")
+        worker = _make_worker(city, out)
+        faults.configure("egress.http=error@0")
+        try:
+            worker.run(iter(lines))
+        finally:
+            faults.clear()
+        snap = metrics.default.snapshot()["counters"]
+        if not snap.get("egress.fail") or not snap.get("egress.deadletter"):
+            return fail(f"outage not spooled: {snap}")
+        if _tile_tree(out):
+            return fail("tiles reached a dead sink")
+        spool = worker.anonymiser.sink.deadletter
+
+        ds = LocalDatastore(os.path.join(tmp, "store"))
+        got = ingest_dir(ds, spool, delete=True)
+        if not got["rows"] or got["failures"]:
+            return fail(f"dead-letter replay failed: {got}")
+        leftover = [p for p in _tile_tree(spool)]
+        if leftover:
+            return fail(f"replayed spool not drained: {leftover[:5]}")
+
+        # fault-free control run -> same aggregate store contents
+        out2 = os.path.join(tmp, "out2")
+        worker2 = _make_worker(city, out2)
+        worker2.run(iter(lines))
+        ds2 = LocalDatastore(os.path.join(tmp, "store2"))
+        got2 = ingest_dir(ds2, out2)
+        s1, s2 = ds.stats(), ds2.stats()
+        for key in ("rows", "cells", "transitions"):
+            if s1[key] != s2[key]:
+                return fail(f"replayed store diverges on {key}: "
+                            f"{s1[key]} != {s2[key]}")
+        log(f"egress_outage ok: {got['files']} tiles replayed from the "
+            f"spool, store parity with fault-free run "
+            f"({s1['rows']} rows)")
+        return 0
+
+
+SCENARIOS = {
+    "storm": scenario_storm,
+    "kill_restore": scenario_kill_restore,
+    "submit_burst": scenario_submit_burst,
+    "egress_outage": scenario_egress_outage,
+}
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    names = argv or ["storm", "kill_restore"]
+    if names == ["all"]:
+        names = list(SCENARIOS)
+    unknown = [n for n in names if n not in SCENARIOS]
+    if unknown:
+        return fail(f"unknown scenario(s) {unknown}; "
+                    f"one of {sorted(SCENARIOS)} or 'all'")
+    for name in names:
+        log(f"=== scenario {name} ===")
+        rc = SCENARIOS[name]()
+        if rc:
+            return rc
+    log(f"all {len(names)} scenario(s) passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
