@@ -1,15 +1,26 @@
 """reporter-lint: project-native static analysis for the framework.
 
-Four AST-based passes pin the invariants the codebase depends on but no
-general-purpose tool can see:
+Eight AST-based passes pin the invariants the codebase depends on but no
+general-purpose tool can see — four intra-module syntactic passes (PR 2)
+and four cross-layer contract passes against the declarative registry
+(:mod:`registry`, PR 6):
 
-  hotpath      HP001-HP003  the columnar host pipeline stays columnar
-  jit_hygiene  JH001-JH003  jitted regions stay device-pure
-  abi          ABI001-ABI005 the ctypes binding mirrors host_runtime.cpp
-  locks        LD001        lock-guarded state is guarded at every write
+  hotpath         HP001-HP003   the columnar host pipeline stays columnar
+  jit_hygiene     JH001-JH003   jitted regions stay device-pure
+  abi             ABI001-ABI005 the ctypes binding mirrors host_runtime.cpp
+  locks           LD001         lock-guarded state is guarded at every write
+  lockgraph       LD002-LD003   no lock cycles; no lock held across
+                                blocking HTTP/subprocess/native calls
+  durability      DUR001-DUR004 tmp+fsync+replace+dir-fsync commits in the
+                                durable modules; epoch marker after sink ack
+  registry_drift  KN001-KN002   env knobs: code <-> registry <-> README
+                  MT001-MT002   metric names: call sites <-> registry
+  fault_coverage  FP001-FP003   failpoint sites: registered, hooked,
+                                and chaos/test-exercised
 
 Driver: ``python tools/lint.py`` (CI ``lint`` stage; ``--abi-only`` is
-the pre-commit ABI guard). Suppress a documented false positive with a
+the pre-commit ABI guard, ``--contracts-only`` the fast cross-layer
+contract guard). Suppress a documented false positive with a
 ``# lint: ignore[RULE-ID]`` comment on the line (or the line above), or
 record it in the committed baseline (``tools/lint_baseline.txt``). See
 README "Static analysis" for the rule catalogue and workflow.
@@ -22,15 +33,23 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional, Sequence
 
-from . import abi, hotpath, jit_hygiene, locks
+from . import (abi, durability, fault_coverage, hotpath, jit_hygiene,
+               lockgraph, locks, registry, registry_drift)
 from .core import (Finding, SourceFile, collect_py_files, compare_baseline,
                    filter_suppressed, load_baseline)
 
-#: the code passes, in report order (abi runs separately on its file pair)
-CODE_PASSES = (hotpath, jit_hygiene, locks)
+#: per-file code passes, in report order (abi runs separately on its
+#: file pair). These are safe on partial runs: a subset of files can
+#: only under-report, never false-fire.
+CODE_PASSES = (hotpath, jit_hygiene, locks, lockgraph, durability)
+
+#: cross-layer contract passes needing the WHOLE package (plus README /
+#: chaos / fault tests) in view — their reverse directions (dead
+#: entries, doc drift, coverage) would false-fire on a subset.
+CONTRACT_PASSES = (registry_drift, fault_coverage)
 
 ALL_RULES: Dict[str, str] = {}
-for _p in (*CODE_PASSES, abi):
+for _p in (*CODE_PASSES, *CONTRACT_PASSES, abi):
     ALL_RULES.update(_p.RULES)
 
 
@@ -42,7 +61,20 @@ def run_code_passes(files: Sequence[SourceFile],
     return sorted(filter_suppressed(findings, files))
 
 
+def run_contract_passes(files: Sequence[SourceFile], repo_root: str,
+                        full_scope: bool = True) -> List[Finding]:
+    """The registry-backed cross-layer passes. ``full_scope`` tells the
+    passes whether the whole package is in view (partial runs check only
+    the code -> registry direction)."""
+    findings: List[Finding] = []
+    for p in CONTRACT_PASSES:
+        findings.extend(p.run(files, repo_root, full_scope=full_scope))
+    return sorted(filter_suppressed(findings, files))
+
+
 __all__ = ["Finding", "SourceFile", "collect_py_files", "load_baseline",
            "compare_baseline", "filter_suppressed", "run_code_passes",
-           "CODE_PASSES", "ALL_RULES", "abi", "hotpath", "jit_hygiene",
-           "locks"]
+           "run_contract_passes", "CODE_PASSES", "CONTRACT_PASSES",
+           "ALL_RULES", "abi", "hotpath", "jit_hygiene", "locks",
+           "lockgraph", "durability", "registry", "registry_drift",
+           "fault_coverage"]

@@ -1,0 +1,160 @@
+"""The cross-layer contract registry: the single source of truth.
+
+PR 5 made correctness depend on hand-maintained cross-layer lists — the
+``REPORTER_TPU_*`` env knobs README documents, the metric names /stats
+consumers grep for, the ``KNOWN_SITES`` failpoint table chaos scenarios
+arm, and the tmp-write -> fsync -> ``os.replace`` commit discipline of
+every durable path. None of them were machine-checked, and five knobs
+had already drifted out of README by PR 6. This module is the fix: ONE
+declarative registry the contract passes (durability, lockgraph,
+registry_drift, fault_coverage) verify both sides of — code that uses
+an unregistered name fails lint, and a registry entry nothing uses
+fails lint too, so the lists can neither rot nor bloat.
+
+Adding a knob / metric / fault site is a three-line change: the code,
+this registry, and (for knobs) README's table — and ``tools/lint.py
+--contracts-only`` tells you which line you forgot.
+
+Like the rest of the analysis package this imports nothing beyond the
+stdlib, so the lint stage needs no accelerator stack.
+"""
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+# ---- environment knobs -----------------------------------------------------
+# Every REPORTER_TPU_* name any code in reporter_tpu/, tools/ or
+# bench.py (or the C++ runtime) reads. Two-sided with the code
+# (registry_drift KN001) and with README's knob table (KN002).
+ENV_KNOBS: Dict[str, str] = {
+    "REPORTER_TPU_PLATFORM": "cpu|accel|auto backend pin",
+    "REPORTER_TPU_PROBE_TIMEOUT_S": "accelerator probe timeout",
+    "REPORTER_TPU_PROBE_TRIES": "accelerator probe attempts",
+    "REPORTER_TPU_PROBE_CACHE": "probe-verdict cache file",
+    "REPORTER_TPU_VIRTUAL_DEVICES": "virtual CPU device count",
+    "REPORTER_TPU_COMPILE_CACHE": "persistent XLA compile cache dir",
+    "REPORTER_TPU_DECODE": "decode backend: scan|assoc|pallas",
+    "REPORTER_TPU_DECODE_CHUNK": "traces per decode dispatch",
+    "REPORTER_TPU_PIPELINE": "device-lane overlap on/off",
+    "REPORTER_TPU_PREP_THREADS": "native prep worker-pool width",
+    "REPORTER_TPU_PREP_TIMINGS": "print native prep phase times",
+    "REPORTER_TPU_ROUTE_MEMO": "native cross-call route-pair memo size",
+    "REPORTER_TPU_ROUTE_CACHE_NODES": "numpy route cache: node entries",
+    "REPORTER_TPU_ROUTE_CACHE_PAIRS": "numpy route cache: pair entries",
+    "REPORTER_TPU_WIRE": "f16|f32 device wire format",
+    "REPORTER_TPU_SHARD": "multi-device mesh decode on/off",
+    "REPORTER_TPU_SEQ_SHARDS": "sequence-parallel time-axis shards",
+    "REPORTER_TPU_COORDINATOR": "jax.distributed rendezvous address",
+    "REPORTER_TPU_NUM_PROCESSES": "jax.distributed process count",
+    "REPORTER_TPU_PROCESS_ID": "jax.distributed process id",
+    "REPORTER_TPU_DATASTORE": "histogram-store dir served on /histogram",
+    "REPORTER_TPU_DATASTORE_HANDLES": "partition mmap-handle LRU size",
+    "REPORTER_TPU_NATIVE_LIB": "prebuilt .so override (sanitizers/CI)",
+    "REPORTER_TPU_FAULTS": "deterministic failpoint spec",
+    "REPORTER_TPU_CIRCUIT_THRESHOLD": "errors that open the breaker",
+    "REPORTER_TPU_CIRCUIT_COOLDOWN_S": "breaker cooldown before a probe",
+    "REPORTER_TPU_SUBMIT_RETRIES": "submit requeues before dead-letter",
+    "REPORTER_TPU_WRITER_ID": "writer tag in epoch tile names",
+    "REPORTER_TPU_CHAOS_REQUIRE_NATIVE": "chaos: missing native = fail",
+}
+
+# ---- metric names ----------------------------------------------------------
+# Every name the code passes to the metrics layer (utils.metrics
+# count/timer/observe). Entries ending in ``*`` are prefix patterns for
+# dynamically-suffixed families (f-string call sites); pattern entries
+# are exempt from the dead-entry check (MT002) precisely because their
+# call sites are dynamic — exact entries must have a literal somewhere.
+METRICS: Dict[str, str] = {
+    # matcher
+    "matcher.prep": "host prep per chunk (timer)",
+    "matcher.decode_dispatch": "jit call + async d2h start (timer)",
+    "matcher.decode_wait": "d2h wait (timer)",
+    "matcher.assemble": "run walk + column conversion (timer)",
+    "matcher.circuit.*": "breaker transitions + degraded-chunk counts",
+    "prep.phase.*": "native prep phase split (candidates/select/routes)",
+    # numpy route cache
+    "route.cache.node_hits": "route cache: node-level hits",
+    "route.cache.node_misses": "route cache: node-level misses",
+    "route.cache.pair_hits": "route cache: pair-level hits",
+    "route.cache.pair_misses": "route cache: pair-level misses",
+    # service
+    "service.requests": "/report requests",
+    "service.requests.histogram": "/histogram requests",
+    "service.handle": "/report handling (timer)",
+    "service.histogram": "/histogram handling (timer)",
+    "service.errors.*": "error responses by status code",
+    "dispatch.batches": "micro-batches dispatched",
+    "dispatch.traces": "traces dispatched",
+    "dispatch.match_many": "batched match call (timer)",
+    "dispatch.errors": "dispatch loop errors",
+    # streaming
+    "egress.ok": "tile egress successes",
+    "egress.fail": "tile egress failures",
+    "egress.deadletter": "tile bodies spooled to the dead letter",
+    "batch.requeued": "failed submits requeued under budget",
+    "batch.dropped": "batches dropped after budget exhaustion",
+    "batch.deadletter": "trace JSON spooled for replay",
+    "state.epoch_skipped": "restores that skipped a committed epoch",
+    "state.save.fail": "failed state snapshots (degraded)",
+    "state.epoch_commit.fail": "failed epoch-marker commits (degraded)",
+    # pipeline
+    "pipeline.gather": "backfill stage 1 (timer)",
+    "pipeline.match": "backfill stage 2 (timer)",
+    "pipeline.report": "backfill stage 3 (timer)",
+    # datastore
+    "datastore.ingest.parse": "tile CSV parse (timer)",
+    "datastore.ingest.bad_rows": "dropped malformed tile rows",
+    "datastore.ingest.dir": "directory replay (timer)",
+    "datastore.ingest.quarantined": "tiles quarantined mid-ingest",
+    "datastore.ingest.files": "tile files replayed",
+    "datastore.query": "histogram query (timer)",
+    "datastore.aggregate": "observation aggregation (timer)",
+    "datastore.aggregate.rows": "observation rows aggregated",
+    "datastore.store.append": "segment commit (timer)",
+    "datastore.store.compact": "compaction pass (timer)",
+    "datastore.store.auto_compactions": "pressure-policy compactions",
+    "datastore.query.cache.hits": "partition-handle LRU hits",
+    "datastore.query.cache.misses": "partition-handle LRU misses",
+}
+
+# ---- failpoint sites -------------------------------------------------------
+# Mirrors utils/faults.py KNOWN_SITES (fault_coverage FP001 verifies the
+# two stay identical) and adds the coverage contract: every site must
+# have >=1 failpoint() call site (FP002) and be exercised by a chaos
+# scenario or a tests/test_faults.py case (FP003).
+FAULT_SITES: Dict[str, str] = {
+    "native.prep": "native prep error -> circuit breaker + fallback",
+    "matcher.submit": "report submit failure -> bounded requeue",
+    "egress.http": "tile sink failure -> dead-letter spool",
+    "datastore.commit": "segment commit failure -> caller quarantine",
+    "state.save": "snapshot failure -> degraded (wider replay window)",
+    "worker.offer": "crash at an exact stream position",
+    "worker.post_egress": "crash between sink ack and epoch marker",
+}
+
+# ---- durable layout roots --------------------------------------------------
+# Modules whose writes land under durable roots (the datastore
+# partition layout, the state snapshot + epoch marker, tile-sink
+# output and the dead-letter spools). The durability pass (DUR001-003)
+# holds every write here to the fsio commit protocol.
+DURABLE_MODULES: Tuple[str, ...] = (
+    "reporter_tpu/datastore/store.py",
+    "reporter_tpu/datastore/ingest.py",
+    "reporter_tpu/streaming/state.py",
+    "reporter_tpu/streaming/anonymiser.py",
+    "reporter_tpu/utils/fsio.py",
+)
+
+# ---- epoch-marker commit ordering (DUR004) ---------------------------------
+# "relpath::qualname" -> (ack_call, commit_call): in the annotated
+# function, every ``commit_call`` must be reachable only AFTER an
+# ``ack_call`` — the exactly-once-ish egress window (a marker committed
+# before the sink acked would make restore skip an epoch the sink never
+# got).
+EPOCH_COMMIT_CONTRACTS: Dict[str, Tuple[str, str]] = {
+    "reporter_tpu/streaming/worker.py::StreamWorker._flush_tiles":
+        ("punctuate", "commit_epoch"),
+}
+
+__all__ = ["ENV_KNOBS", "METRICS", "FAULT_SITES", "DURABLE_MODULES",
+           "EPOCH_COMMIT_CONTRACTS"]

@@ -41,7 +41,7 @@ from typing import Dict, Iterator, List, Optional, Tuple
 
 import numpy as np
 
-from ..utils import faults, metrics
+from ..utils import faults, fsio, metrics
 from .aggregate import Delta, aggregate, merge_deltas
 from .schema import ObservationBatch
 
@@ -78,11 +78,9 @@ class HistogramStore:
         os.makedirs(root, exist_ok=True)
         self._lock = threading.Lock()
         if handle_cache_size is None:
-            try:
-                handle_cache_size = int(os.environ.get(
-                    "REPORTER_TPU_DATASTORE_HANDLES", "") or 64)
-            except ValueError:
-                handle_cache_size = 64
+            from ..utils.runtime import _env_int
+            handle_cache_size = _env_int(
+                "REPORTER_TPU_DATASTORE_HANDLES", 64)
         self.handle_cache_size = max(0, handle_cache_size)
         self._handle_lock = threading.Lock()
         # (pdir, (segment names...)) -> [Delta] of live mmap handles
@@ -114,12 +112,11 @@ class HistogramStore:
             return {"seq": 0, "segments": []}
 
     def _write_manifest(self, pdir: str, manifest: dict) -> None:
-        tmp = os.path.join(pdir, ".MANIFEST.tmp")
-        with open(tmp, "w", encoding="utf-8") as f:
-            json.dump(manifest, f)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, os.path.join(pdir, MANIFEST))
+        # the manifest IS the commit point: tmp + fsync + replace + dir
+        # fsync (fsio), so a power loss can neither tear it nor lose
+        # the rename (reporter-lint DUR002/DUR003)
+        fsio.atomic_write_text(os.path.join(pdir, MANIFEST),
+                               json.dumps(manifest))
 
     # -- write path --------------------------------------------------------
     def append(self, level: int, index: int, delta: Delta) -> str:
@@ -145,13 +142,24 @@ class HistogramStore:
         tmp = os.path.join(pdir, f".tmp-{name}-{os.getpid()}")
         os.makedirs(tmp)
         for col, dtype in _COLUMNS:
-            np.save(os.path.join(tmp, col + ".npy"),
+            col_path = os.path.join(tmp, col + ".npy")
+            np.save(col_path,
                     np.ascontiguousarray(getattr(delta, col), dtype=dtype))
-        with open(os.path.join(tmp, "meta.json"), "w", encoding="utf-8") as f:
+            fsio.fsync_path(col_path)
+        tmp_meta = os.path.join(tmp, "meta.json")
+        with open(tmp_meta, "w", encoding="utf-8") as f:
             json.dump({"cells": len(delta), "rows": delta.rows,
                        "transitions": int(delta.trans_from.shape[0]),
                        "created": time.time()}, f)
+            f.flush()
+            os.fsync(f.fileno())
+        # rename durability (reporter-lint DUR002/DUR003): every column
+        # is fsync'd above, the segment dir's entries are fsync'd, THEN
+        # the rename, THEN the partition dir — a power loss right after
+        # the manifest lists this segment cannot surface empty columns
+        fsio.fsync_dir(tmp)
         os.replace(tmp, os.path.join(pdir, name))
+        fsio.fsync_dir(pdir)
 
     def ingest(self, obs: ObservationBatch,
                max_deltas: Optional[int] = None,

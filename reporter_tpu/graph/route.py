@@ -33,10 +33,8 @@ _ENV_PAIR_CAP = "REPORTER_TPU_ROUTE_CACHE_PAIRS"
 
 
 def _env_cap(name: str, default: int) -> int:
-    try:
-        return max(1, int(os.environ.get(name, "") or default))
-    except ValueError:
-        return default
+    from ..utils.runtime import _env_int
+    return max(1, _env_int(name, default))
 
 
 def _edge_secs(net: RoadNetwork, e: int, meters: float) -> float:

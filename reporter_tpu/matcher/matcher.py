@@ -57,12 +57,10 @@ def _decode_chunk() -> int:
     core at 512 vs 128) until per-chunk tensors (route_m: 16 MB f32 at
     512) outgrow cache and memory bandwidth takes it back (1024-row
     chunks measured ~10% SLOWER than 512)."""
-    val = os.environ.get("REPORTER_TPU_DECODE_CHUNK", "").strip()
+    from ..utils.runtime import _env_int
+    val = _env_int("REPORTER_TPU_DECODE_CHUNK", 0)
     if val:
-        try:
-            return max(1, int(val))
-        except ValueError:
-            pass
+        return max(1, val)
     if pipeline_enabled() and (os.cpu_count() or 1) > 1:
         return 128
     return 512
@@ -70,11 +68,9 @@ def _decode_chunk() -> int:
 
 def _prep_workers() -> int:
     """Host-prep thread count (env-tunable; 0 disables the pool)."""
-    try:
-        return int(os.environ.get("REPORTER_TPU_PREP_THREADS",
-                                  min(32, os.cpu_count() or 1)))
-    except ValueError:
-        return min(32, os.cpu_count() or 1)
+    from ..utils.runtime import _env_int
+    return _env_int("REPORTER_TPU_PREP_THREADS",
+                    min(32, os.cpu_count() or 1))
 
 
 def pipeline_enabled() -> bool:

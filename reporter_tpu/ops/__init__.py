@@ -77,10 +77,8 @@ def _sharded_run():
         if n <= 1:
             _sharded_cache = (None, 1, 1)
             return _sharded_cache
-        try:
-            seq = max(1, int(os.environ.get("REPORTER_TPU_SEQ_SHARDS", "1")))
-        except ValueError:
-            seq = 1
+        from ..utils.runtime import _env_int
+        seq = max(1, _env_int("REPORTER_TPU_SEQ_SHARDS", 1))
         seq = min(seq, n)
         while n % seq:  # largest feasible seq <= requested
             seq -= 1

@@ -55,14 +55,15 @@ class ReporterService:
         self.matcher = matcher
         # optional LocalDatastore serving /histogram (None = 503 there)
         self.datastore = datastore
+        from ..utils.runtime import _env_float, _env_int
         self.threshold_sec = threshold_sec if threshold_sec is not None else \
-            int(os.environ.get("THRESHOLD_SEC", 15))
+            _env_int("THRESHOLD_SEC", 15)
         self.dispatcher = BatchDispatcher(
             matcher.match_many,
-            max_batch=max_batch or int(os.environ.get("MATCH_BATCH_MAX", 256)),
+            max_batch=max_batch or _env_int("MATCH_BATCH_MAX", 256),
             max_wait_ms=max_wait_ms if max_wait_ms is not None else
-            float(os.environ.get("MATCH_BATCH_WAIT_MS", 20.0)),
-            idle_grace_ms=float(os.environ.get("MATCH_BATCH_GRACE_MS", 2.0)))
+            _env_float("MATCH_BATCH_WAIT_MS", 20.0),
+            idle_grace_ms=_env_float("MATCH_BATCH_GRACE_MS", 2.0))
 
     def handle(self, trace: dict) -> tuple[int, str]:
         """Validate + match + report; (status, body). Validation messages
@@ -315,14 +316,11 @@ class BoundedThreadingHTTPServer(ThreadingHTTPServer):
 
     def __init__(self, addr, handler, pool_size: int | None = None):
         if pool_size is None:
-            count = os.environ.get("THREAD_POOL_COUNT")
-            mult = os.environ.get("THREAD_POOL_MULTIPLIER")
-            if count:
-                pool_size = int(count)
-            elif mult:
-                pool_size = int(mult) * multiprocessing.cpu_count()
-            else:
-                pool_size = 64
+            from ..utils.runtime import _env_int
+            count = _env_int("THREAD_POOL_COUNT", 0)
+            mult = _env_int("THREAD_POOL_MULTIPLIER", 0)
+            pool_size = count or \
+                (mult * multiprocessing.cpu_count() if mult else 64)
         self._slots = threading.BoundedSemaphore(max(1, pool_size))
         super().__init__(addr, handler)
 

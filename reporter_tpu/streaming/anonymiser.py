@@ -25,7 +25,7 @@ import tempfile
 from typing import Dict, List, Optional
 
 from ..core.types import Segment, TimeQuantisedTile
-from ..utils import faults
+from ..utils import faults, fsio
 from ..utils import http as http_egress
 from ..utils import metrics
 
@@ -105,8 +105,12 @@ class TileSink:
             else:
                 path = os.path.join(self.output, tile_name)
                 os.makedirs(path, exist_ok=True)
-                with open(os.path.join(path, file_name), "w") as f:
-                    f.write(payload)
+                # atomic commit (reporter-lint DUR001): these files
+                # carry deterministic epoch names — a torn write under
+                # the final name after a crash would be "committed"
+                # garbage the epoch marker then tells restore to skip
+                fsio.atomic_write_text(os.path.join(path, file_name),
+                                       payload)
                 ok = True
             if ok:
                 faults.failpoint("egress.http", after=True)
@@ -125,8 +129,11 @@ class TileSink:
         try:
             path = os.path.join(self.deadletter, tile_name)
             os.makedirs(path, exist_ok=True)
-            with open(os.path.join(path, file_name), "w") as f:
-                f.write(payload)
+            # atomic spool (reporter-lint DUR001): a torn dead-letter
+            # body would replay as a silently-truncated tile — ingest
+            # drops malformed rows rather than failing the file
+            fsio.atomic_write_text(os.path.join(path, file_name),
+                                   payload)
             metrics.count("egress.deadletter")
             logger.warning("Spooled failed tile to %s/%s/%s",
                            self.deadletter, tile_name, file_name)

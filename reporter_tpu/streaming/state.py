@@ -56,7 +56,7 @@ import time
 from typing import Optional
 
 from ..core.types import Point, Segment, TimeQuantisedTile
-from ..utils import faults, metrics
+from ..utils import faults, fsio, metrics
 from .batcher import Batch, PointBatcher
 from .anonymiser import Anonymiser
 
@@ -207,13 +207,7 @@ class StateStore:
         """Durably record that ``epoch``'s tiles fully reached the sink.
         Called between egress and the post-flush snapshot — it is what
         lets restore tell "flushed then crashed" from "crashed mid-way"."""
-        tmp = self.epoch_path + ".tmp"
-        with open(tmp, "w", encoding="utf-8") as f:
-            f.write(str(int(epoch)))
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, self.epoch_path)
-        self._fsync_dir()
+        fsio.atomic_write_text(self.epoch_path, str(int(epoch)))
 
     def committed_epoch(self) -> int:
         """The last epoch known to have fully egressed; -1 when none."""
@@ -222,21 +216,6 @@ class StateStore:
                 return int(f.read().strip())
         except (FileNotFoundError, ValueError):
             return -1
-
-    def _fsync_dir(self) -> None:
-        # directory fsync so the rename itself is durable; best-effort
-        # on filesystems/platforms that refuse O_RDONLY dir fds
-        parent = os.path.dirname(os.path.abspath(self.path))
-        try:
-            fd = os.open(parent, os.O_RDONLY)
-        except OSError:
-            return
-        try:
-            os.fsync(fd)
-        except OSError:
-            pass
-        finally:
-            os.close(fd)
 
     # -- snapshot ----------------------------------------------------------
     def restore(self, batcher: PointBatcher,
@@ -293,16 +272,11 @@ class StateStore:
 
     def save(self, batcher: PointBatcher, anonymiser: Anonymiser) -> None:
         faults.failpoint("state.save")
-        tmp = self.path + ".tmp"
-        with open(tmp, "wb") as f:
-            f.write(snapshot_bytes(batcher, anonymiser))
-            f.flush()
-            # fsync BEFORE the rename: os.replace promises atomicity,
-            # not durability — after a power loss an un-fsynced rename
-            # can legally surface as the new name with EMPTY contents
-            os.fsync(f.fileno())
-        os.replace(tmp, self.path)
-        self._fsync_dir()
+        # tmp + fsync + replace + dir fsync via fsio: os.replace
+        # promises atomicity, not durability — after a power loss an
+        # un-fsynced rename can legally surface as an EMPTY new name
+        fsio.atomic_write_bytes(self.path,
+                                snapshot_bytes(batcher, anonymiser))
         faults.failpoint("state.save", after=True)
         self._last_save = self.clock()
 

@@ -1,0 +1,93 @@
+"""Crash-consistent file commit helpers: the ONE atomic-write discipline.
+
+Every durable write in this framework (datastore segment/manifest
+commits, state snapshots, epoch markers, tile-sink files, dead-letter
+spools) follows the same four-step protocol:
+
+    1. write the full payload to a dot-prefixed temp name in the target
+       directory
+    2. ``fsync`` the temp file — ``os.replace`` promises *atomicity*,
+       not *durability*: after a power loss an un-fsynced rename can
+       legally surface as the new name with EMPTY contents
+    3. ``os.replace`` the temp name over the final name
+    4. ``fsync`` the parent directory so the rename itself is durable
+
+Before this module each durable writer hand-rolled the protocol (and
+two of them — the datastore segment writer and the tile sink — got it
+wrong: no fsync before the rename, or no rename at all). Centralising
+it here gives reporter-lint's durability pass (analysis/durability.py,
+DUR001-DUR003) a single verified implementation: callers that write
+through :func:`atomic_write_text`/:func:`atomic_write_bytes` are clean
+by construction, and this file stays in the pass's durable-module scope
+so the helper itself can never silently lose a step.
+
+Directory fsyncs are best-effort: some filesystems/platforms refuse
+O_RDONLY directory fds, and degrading to "atomic but not
+power-loss-durable" beats refusing to run there.
+"""
+from __future__ import annotations
+
+import os
+
+
+def fsync_path(path: str) -> None:
+    """fsync one already-written file by path (best-effort open)."""
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def fsync_dir(path: str) -> None:
+    """fsync a directory so a rename inside it is durable; best-effort
+    on filesystems/platforms that refuse O_RDONLY dir fds."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def _atomic_write(path: str, data: bytes) -> None:
+    path = os.path.abspath(path)
+    parent, name = os.path.split(path)
+    tmp = os.path.join(parent, f".{name}.tmp-{os.getpid()}")
+    try:
+        with open(tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        # a failed commit must not leave a stray temp file for directory
+        # scanners (scan_tiles skips dot names, but the spool replayer
+        # globs); the target is untouched either way
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    fsync_dir(parent)
+
+
+def atomic_write_bytes(path: str, data: bytes) -> None:
+    """Commit ``data`` to ``path`` via tmp + fsync + replace + dir
+    fsync. On ANY failure the previous contents of ``path`` are intact
+    and no temp file is left behind."""
+    _atomic_write(path, data)
+
+
+def atomic_write_text(path: str, text: str,
+                      encoding: str = "utf-8") -> None:
+    """:func:`atomic_write_bytes` for str payloads."""
+    _atomic_write(path, text.encode(encoding))
+
+
+__all__ = ["fsync_path", "fsync_dir", "atomic_write_bytes",
+           "atomic_write_text"]

@@ -1,0 +1,39 @@
+"""Durability fixture: every DUR rule fires where annotated."""
+import json
+import os
+
+
+def bare_write(root, name, payload):
+    path = os.path.join(root, name)
+    with open(path, "w") as f:  # DUR001: torn-file window
+        f.write(payload)
+
+
+def unsynced_replace(root, manifest):
+    tmp = os.path.join(root, ".m.tmp")
+    with open(tmp, "w") as f:
+        json.dump(manifest, f)
+    os.replace(tmp, os.path.join(root, "m"))  # DUR002: no fsync  # DUR003: no dir fsync
+
+
+def no_dir_fsync(root, manifest):
+    tmp = os.path.join(root, ".m.tmp")
+    with open(tmp, "w") as f:
+        json.dump(manifest, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, os.path.join(root, "m"))  # DUR003: rename not durable
+
+
+def commit_before_ack(state, anonymiser):
+    epoch = anonymiser.flush_epoch
+    state.commit_epoch(epoch)  # DUR004: marker before the sink ack
+    anonymiser.punctuate()
+
+
+def commit_without_ack(state, anonymiser):  # (never acks at all)
+    state.commit_epoch(anonymiser.flush_epoch)  # DUR004: marker before the sink ack
+
+
+def missing_commit(state, anonymiser):  # DUR004: contract, no commit call
+    anonymiser.punctuate()

@@ -1,0 +1,38 @@
+"""Durability fixture: the disciplined patterns stay silent."""
+import json
+import os
+
+from reporter_tpu.utils import fsio
+
+
+def atomic_helper_write(root, name, payload):
+    # routed through the verified commit helper: no local discipline
+    fsio.atomic_write_text(os.path.join(root, name), payload)
+
+
+def full_protocol(root, manifest):
+    tmp = os.path.join(root, ".m.tmp")
+    with open(tmp, "w") as f:
+        json.dump(manifest, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, os.path.join(root, "m"))
+    fsio.fsync_dir(root)
+
+
+def read_paths_are_fine(root):
+    with open(os.path.join(root, "m")) as f:
+        return json.load(f)
+
+
+def quarantine_rename(root, name):
+    # renaming an already-committed file is not a tmp-commit: exempt
+    os.replace(os.path.join(root, name),
+               os.path.join(root, f".{name}.failed"))
+
+
+def commit_after_ack(state, anonymiser):
+    epoch = anonymiser.flush_epoch
+    written = anonymiser.punctuate()
+    if written > 0:
+        state.commit_epoch(epoch)

@@ -1,0 +1,9 @@
+"""Fault-coverage fixture: unknown and unauditable failpoint sites
+(against an injected registry of ``{"known.site"}``)."""
+from reporter_tpu.utils import faults
+
+
+def hooked(site_var):
+    faults.failpoint("known.site")
+    faults.failpoint("not.a.site")  # FP001: site unknown to the registry
+    faults.failpoint(site_var)  # FP001: non-literal site name

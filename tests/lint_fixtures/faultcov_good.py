@@ -1,0 +1,10 @@
+"""Fault-coverage fixture: registered sites, both hook positions
+(against an injected registry of ``{"known.site"}``)."""
+from reporter_tpu.utils import faults
+
+
+def hooked(effect):
+    faults.failpoint("known.site")
+    result = effect()
+    faults.failpoint("known.site", after=True)
+    return result

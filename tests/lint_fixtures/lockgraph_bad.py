@@ -1,0 +1,61 @@
+"""Lock-graph fixture: a cycle and blocking calls under locks.
+
+Findings anchor at the ``with`` acquisition line; the LD002 cycle is
+reported once, at the acquisition that closes it (Right -> Left).
+"""
+import subprocess
+import threading
+import urllib.request
+
+
+class Left:
+    def __init__(self, right):
+        self._lock = threading.Lock()
+        self.right = right
+
+    def poke(self):
+        with self._lock:  # (records the Left -> Right edge)
+            self.right.look()
+
+    def peek(self):
+        with self._lock:
+            return 1
+
+
+class Right:
+    def __init__(self, left):
+        self._lock = threading.Lock()
+        self.left = left
+
+    def poke(self):
+        with self._lock:  # LD002: closes the Left->Right->Left cycle
+            self.left.peek()
+
+    def look(self):
+        with self._lock:
+            return 2
+
+
+class Fetcher:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.lib = None
+
+    def fetch(self, url):
+        with self._lock:  # LD003: HTTP under a lock
+            return urllib.request.urlopen(url)
+
+    def rebuild(self):
+        with self._lock:  # LD003: subprocess under a lock
+            subprocess.run(["make"], check=True)
+
+    def native(self, handle):
+        with self._lock:  # LD003: rt_* native under a lock
+            return self.lib.rt_prepare_batch(handle)
+
+    def indirect(self, url):
+        with self._lock:  # LD003: HTTP via a resolvable helper
+            return self._do_fetch(url)
+
+    def _do_fetch(self, url):
+        return urllib.request.urlopen(url)

@@ -1,0 +1,45 @@
+"""Lock-graph fixture: ordered nesting and lock-free blocking calls."""
+import subprocess
+import threading
+import urllib.request
+
+
+class Outer:
+    """Consistent one-way nesting (outer -> inner) is not a cycle."""
+
+    def __init__(self, inner):
+        self._lock = threading.Lock()
+        self.inner = inner
+
+    def poke(self):
+        with self._lock:
+            self.inner.observe()
+
+
+class Inner:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def observe(self):
+        with self._lock:
+            return 1
+
+
+class Fetcher:
+    """Blocking work runs OUTSIDE the lock; the lock guards the cache."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.cache = {}
+
+    def fetch(self, url):
+        body = urllib.request.urlopen(url)
+        with self._lock:
+            self.cache[url] = body
+        return body
+
+    def rebuild(self):
+        proc = subprocess.run(["make"], check=True)
+        with self._lock:
+            self.cache.clear()
+        return proc

@@ -1,0 +1,21 @@
+"""Registry-drift fixture: unregistered knob reads and metric names.
+
+The fixture tests run this against an injected registry of
+``{REPORTER_TPU_KNOWN}`` / ``{"known.metric", "family.*"}``.
+"""
+import os
+
+from reporter_tpu.utils import metrics
+
+
+def read_unknown_knob():
+    os.environ.get("REPORTER_TPU_KNOWN")
+    return os.environ.get("REPORTER_TPU_NOT_REGISTERED")  # KN001: unregistered knob
+
+
+def emit_unknown_metric(code):
+    metrics.count("known.metric")
+    metrics.count(f"family.{code}")
+    metrics.count("rogue.metric")  # MT001: unregistered literal
+    with metrics.timer(f"other.family.{code}"):  # MT001: unregistered f-string family
+        pass

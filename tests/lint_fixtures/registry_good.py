@@ -1,0 +1,20 @@
+"""Registry-drift fixture: registered names and unresolvable-by-design
+dynamic sites stay silent (against the same injected registry as the
+bad fixture)."""
+import os
+
+from reporter_tpu.utils import metrics
+
+
+def read_known_knob():
+    return os.environ.get("REPORTER_TPU_KNOWN")
+
+
+def emit_known_metrics(code, name):
+    metrics.count("known.metric")
+    metrics.count(f"family.{code}")
+    metrics.observe("known.metric", 0.5)
+    # dynamic from the first character: unauditable, skipped (register
+    # the instantiated family as a pattern instead)
+    metrics.count(f"{name}.opened")
+    metrics.count(name)

@@ -293,6 +293,29 @@ class TestFailpointSites:
         faults.clear()
         assert ds.ingest(obs) == 1
 
+    def test_worker_post_egress_failpoint(self, tmp_path):
+        """worker.post_egress sits in THE window the flush-epoch
+        machinery exists for: after the sink ack, before the epoch
+        marker — a fault there must leave the epoch uncommitted so a
+        restore re-emits under the same deterministic names."""
+        from reporter_tpu.streaming.anonymiser import Anonymiser, TileSink
+        from reporter_tpu.streaming.formatter import Formatter
+        from reporter_tpu.streaming.state import StateStore
+        from reporter_tpu.streaming.worker import StreamWorker
+        state = StateStore(str(tmp_path / "s.bin"), interval_s=0.0)
+        worker = StreamWorker(
+            Formatter.from_config(r",sv,\|,0,1,2,3,4"), lambda t: None,
+            Anonymiser(TileSink(str(tmp_path / "t")), privacy=1,
+                       quantisation=3600),
+            flush_interval_s=1e9, state=state)
+        faults.configure("worker.post_egress=error")
+        try:
+            with pytest.raises(faults.FaultError):
+                worker._flush_tiles()
+        finally:
+            faults.clear()
+        assert state.committed_epoch() == -1
+
     def test_egress_partial_spools_despite_committed_write(self, tmp_path):
         """kind=partial: the tile REACHES the file sink, yet the caller
         sees failure and spools — the committed-but-unacked window."""

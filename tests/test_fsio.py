@@ -1,0 +1,118 @@
+"""utils.fsio: the atomic-commit helpers, plus regression pins for the
+durable writers reporter-lint's DUR pass flagged in PR 6 (tile sink and
+dead-letter spool torn-write windows, un-fsync'd datastore segments)."""
+import json
+import os
+
+import pytest
+
+from reporter_tpu.utils import fsio
+
+
+class TestAtomicWrite:
+    def test_roundtrip_and_no_temp_leftovers(self, tmp_path):
+        path = tmp_path / "out.txt"
+        fsio.atomic_write_text(str(path), "hello")
+        assert path.read_text() == "hello"
+        fsio.atomic_write_bytes(str(path), b"\x00\x01")
+        assert path.read_bytes() == b"\x00\x01"
+        assert [n for n in os.listdir(tmp_path)] == ["out.txt"]
+
+    def test_failed_commit_preserves_previous_contents(self, tmp_path,
+                                                       monkeypatch):
+        path = tmp_path / "out.txt"
+        fsio.atomic_write_text(str(path), "committed")
+
+        def boom(src, dst):
+            raise OSError("simulated crash at the rename")
+        monkeypatch.setattr(os, "replace", boom)
+        with pytest.raises(OSError):
+            fsio.atomic_write_text(str(path), "torn")
+        monkeypatch.undo()
+        assert path.read_text() == "committed"
+        # and the failed commit cleaned its temp file up
+        assert [n for n in os.listdir(tmp_path)] == ["out.txt"]
+
+    def test_failed_write_leaves_no_temp(self, tmp_path, monkeypatch):
+        real_fsync = os.fsync
+
+        def boom(fd):
+            raise OSError("simulated fsync failure")
+        monkeypatch.setattr(os, "fsync", boom)
+        with pytest.raises(OSError):
+            fsio.atomic_write_text(str(tmp_path / "x"), "data")
+        monkeypatch.setattr(os, "fsync", real_fsync)
+        assert os.listdir(tmp_path) == []
+
+    def test_fsync_helpers_tolerate_directories(self, tmp_path):
+        p = tmp_path / "f"
+        p.write_text("x")
+        fsio.fsync_path(str(p))     # file: must not raise
+        fsio.fsync_dir(str(tmp_path))   # dir: must not raise
+        fsio.fsync_dir(str(tmp_path / "missing"))  # absent: best-effort
+
+
+class TestDurableWritersUseTheProtocol:
+    """The PR 6 DUR fixes, pinned behaviourally: a crash at the rename
+    leaves the previous committed state visible and no torn finals."""
+
+    def test_tile_sink_crash_at_rename_leaves_no_torn_tile(
+            self, tmp_path, monkeypatch):
+        from reporter_tpu.streaming.anonymiser import TileSink
+        sink = TileSink(str(tmp_path / "out"))
+        assert sink.store("1_2/0/1", "t.e00000000", "epoch0") is True
+        tile = tmp_path / "out" / "1_2" / "0" / "1" / "t.e00000000"
+        assert tile.read_text() == "epoch0"
+
+        real_replace = os.replace
+
+        def boom(src, dst):
+            raise OSError("simulated crash")
+        monkeypatch.setattr(os, "replace", boom)
+        # the re-emit of the SAME epoch name crashes mid-commit: the
+        # sink reports failure, the committed bytes survive untorn
+        assert sink.store("1_2/0/1", "t.e00000000", "epoch0-again") \
+            is False
+        monkeypatch.setattr(os, "replace", real_replace)
+        assert tile.read_text() == "epoch0"
+        names = os.listdir(tmp_path / "out" / "1_2" / "0" / "1")
+        assert names == ["t.e00000000"], names
+
+    def test_deadletter_spool_is_atomic(self, tmp_path):
+        from reporter_tpu.streaming.anonymiser import TileSink
+        from reporter_tpu.utils import faults
+        sink = TileSink(str(tmp_path / "out"))
+        faults.configure("egress.http=error")
+        try:
+            assert sink.store("1_2/0/1", "t.e00000001", "body") is False
+        finally:
+            faults.clear()
+        spool = tmp_path / "out" / ".deadletter" / "1_2" / "0" / "1"
+        assert (spool / "t.e00000001").read_text() == "body"
+        assert os.listdir(spool) == ["t.e00000001"]
+
+    def test_datastore_segment_commit_survives_reload(self, tmp_path):
+        """The fsync'd segment writer still round-trips (mechanics are
+        invisible to tests; the commit contract is not)."""
+        import numpy as np
+        from reporter_tpu.datastore import LocalDatastore
+        from reporter_tpu.datastore.schema import ObservationBatch
+        ds = LocalDatastore(str(tmp_path / "store"))
+        obs = ObservationBatch(
+            segment_id=np.array([1 << 25], dtype=np.int64),
+            next_id=np.array([2 << 25], dtype=np.int64),
+            duration_s=np.array([30.0]),
+            count=np.array([1], dtype=np.int64),
+            length_m=np.array([500], dtype=np.int64),
+            queue_m=np.array([0], dtype=np.int64),
+            min_ts=np.array([1500000000], dtype=np.int64),
+            max_ts=np.array([1500000030], dtype=np.int64))
+        assert ds.ingest(obs) == 1
+        stats = ds.stats()
+        assert stats["segments"] == 1 and stats["rows"] == 1
+        # no stray temp dirs/files in the partition after the commit
+        store_root = tmp_path / "store"
+        stray = [os.path.join(d, n)
+                 for d, _, names in os.walk(store_root) for n in names
+                 if n.startswith(".") and n != "MANIFEST.json"]
+        assert stray == [], stray

@@ -15,7 +15,9 @@ FIXTURES = os.path.join(REPO, "tests", "lint_fixtures")
 sys.path.insert(0, REPO)
 
 from reporter_tpu import analysis                      # noqa: E402
-from reporter_tpu.analysis import abi, hotpath, jit_hygiene, locks  # noqa: E402
+from reporter_tpu.analysis import (abi, durability, fault_coverage,  # noqa: E402
+                                   hotpath, jit_hygiene, lockgraph, locks,
+                                   registry, registry_drift)
 from reporter_tpu.analysis.core import SourceFile, parse_suppressions  # noqa: E402
 
 LIVE_CPP = os.path.join(REPO, abi.DEFAULT_CPP)
@@ -138,6 +140,251 @@ def test_suppression_comment_silences_rule():
                      text=bare, tree=ast.parse(bare),
                      suppressions=parse_suppressions(bare))
     assert any(f.rule == "HP002" for f in hotpath.run([sf2], REPO))
+
+
+# ---- durability ------------------------------------------------------------
+
+_DUR_FIXTURE_CONTRACTS = {
+    f"reporter_tpu/streaming/fixture_bad.py::{fn}":
+        ("punctuate", "commit_epoch")
+    for fn in ("commit_before_ack", "commit_without_ack",
+               "missing_commit")}
+_DUR_GOOD_CONTRACTS = {
+    "reporter_tpu/streaming/fixture_good.py::commit_after_ack":
+        ("punctuate", "commit_epoch")}
+
+
+def test_durability_fires_on_bad_fixture():
+    sf = _fixture("durability_bad.py",
+                  "reporter_tpu/streaming/fixture_bad.py")
+    findings = analysis.filter_suppressed(
+        durability.run([sf], REPO, modules=(sf.relpath,),
+                       contracts=_DUR_FIXTURE_CONTRACTS), [sf])
+    _assert_matches_annotations(sf, findings,
+                                ("DUR001", "DUR002", "DUR003", "DUR004"))
+
+
+def test_durability_silent_on_good_fixture():
+    sf = _fixture("durability_good.py",
+                  "reporter_tpu/streaming/fixture_good.py")
+    findings = durability.run([sf], REPO, modules=(sf.relpath,),
+                              contracts=_DUR_GOOD_CONTRACTS)
+    assert findings == []
+
+
+def test_durability_scope_is_declared_module_set():
+    # the same bad writes OUTSIDE the durable-module set are not flagged
+    sf = _fixture("durability_bad.py", "reporter_tpu/tools/fixture.py")
+    findings = durability.run([sf], REPO, contracts={})
+    assert findings == []
+
+
+def test_durability_live_flush_contract_holds():
+    """The shipped worker._flush_tiles satisfies the epoch-commit
+    ordering, and reordering the marker before the egress is caught —
+    the ABI live-pair pattern applied to the CFG contract."""
+    live = _read(os.path.join(REPO, "reporter_tpu", "streaming",
+                              "worker.py"))
+    sf = SourceFile.load(
+        os.path.join(REPO, "reporter_tpu", "streaming", "worker.py"),
+        REPO)
+    assert durability.run([sf], REPO) == []
+    # mutate a copy: commit the epoch BEFORE punctuate
+    target = "written = self.anonymiser.punctuate()"
+    assert target in live, "worker flush drifted; update the injection"
+    mutated = live.replace(
+        target,
+        "self.state.commit_epoch(epoch)\n        " + target, 1)
+    import ast
+    bad = SourceFile(path="x", relpath="reporter_tpu/streaming/worker.py",
+                     text=mutated, tree=ast.parse(mutated),
+                     suppressions={})
+    findings = durability.run([bad], REPO)
+    assert any(f.rule == "DUR004" for f in findings), \
+        [f.render() for f in findings]
+
+
+def test_durability_live_modules_are_clean():
+    files = [SourceFile.load(os.path.join(REPO, rel), REPO)
+             for rel in registry.DURABLE_MODULES]
+    findings = analysis.filter_suppressed(
+        durability.run(files, REPO), files)
+    assert findings == [], [f.render() for f in findings]
+
+
+# ---- lock graph ------------------------------------------------------------
+
+def test_lockgraph_fires_on_bad_fixture():
+    sf, findings = _run_pass(lockgraph, "lockgraph_bad.py",
+                             "reporter_tpu/streaming/fixture_bad.py")
+    _assert_matches_annotations(sf, findings, ("LD002", "LD003"))
+
+
+def test_lockgraph_silent_on_good_fixture():
+    _, findings = _run_pass(lockgraph, "lockgraph_good.py",
+                            "reporter_tpu/streaming/fixture_good.py")
+    assert findings == []
+
+
+def test_lockgraph_native_build_lock_is_the_only_suppression():
+    """The live package carries exactly one documented LD003 hold: the
+    native once-only build lock (subprocess make + ABI handshake)."""
+    files = analysis.collect_py_files(REPO)
+    raw = lockgraph.run(files, REPO)
+    native = [f for f in raw
+              if f.path == "reporter_tpu/native/__init__.py"
+              and f.rule == "LD003"]
+    assert native, "the build-lock hold disappeared — update the test"
+    kept = analysis.filter_suppressed(raw, files)
+    assert kept == [], [f.render() for f in kept]
+
+
+# ---- registry drift --------------------------------------------------------
+
+_FIXTURE_KNOBS = {"REPORTER_TPU_KNOWN": "fixture knob"}
+_FIXTURE_METRICS = {"known.metric": "fixture", "family.*": "fixture"}
+
+
+def _run_registry(name, relpath):
+    sf = _fixture(name, relpath)
+    findings = analysis.filter_suppressed(
+        registry_drift.run([sf], REPO, knobs=_FIXTURE_KNOBS,
+                           metrics_reg=_FIXTURE_METRICS,
+                           readme_text="", full_scope=False), [sf])
+    return sf, findings
+
+
+def test_registry_drift_fires_on_bad_fixture():
+    sf, findings = _run_registry("registry_bad.py",
+                                 "reporter_tpu/streaming/fixture_bad.py")
+    _assert_matches_annotations(sf, findings, ("KN001", "MT001"))
+
+
+def test_registry_drift_silent_on_good_fixture():
+    _, findings = _run_registry("registry_good.py",
+                                "reporter_tpu/streaming/fixture_good.py")
+    assert findings == []
+
+
+def test_registry_dead_knob_and_readme_drift_detected():
+    """Full-scope reverse directions against the LIVE tree: dropping a
+    knob from a registry copy fires KN001 nowhere but KN002+code drift
+    where expected, and an unregistered README row fires KN002."""
+    files = analysis.collect_py_files(
+        REPO, [os.path.join(REPO, "reporter_tpu"),
+               os.path.join(REPO, "tools"),
+               os.path.join(REPO, "bench.py")])
+    readme = _read(os.path.join(REPO, "README.md"))
+    # a registered-but-never-mentioned knob is a dead entry (KN001)
+    knobs = dict(registry.ENV_KNOBS, REPORTER_TPU_GHOST="never read")
+    findings = registry_drift.run(files, REPO, knobs=knobs,
+                                  readme_text=readme)
+    assert any(f.rule == "KN001" and "REPORTER_TPU_GHOST" in f.message
+               for f in findings)
+    assert any(f.rule == "KN002" and "REPORTER_TPU_GHOST" in f.message
+               for f in findings)
+    # dropping a live knob from the registry: its read sites fire KN001
+    # and its README row fires KN002
+    knobs = dict(registry.ENV_KNOBS)
+    del knobs["REPORTER_TPU_FAULTS"]
+    findings = registry_drift.run(files, REPO, knobs=knobs,
+                                  readme_text=readme)
+    assert any(f.rule == "KN001" and "REPORTER_TPU_FAULTS" in f.message
+               for f in findings)
+    assert any(f.rule == "KN002" and f.path == "README.md"
+               and "REPORTER_TPU_FAULTS" in f.message
+               for f in findings)
+
+
+def test_registry_dead_metric_detected():
+    files = analysis.collect_py_files(REPO)
+    metrics_reg = dict(registry.METRICS, **{"ghost.metric": "dead"})
+    findings = registry_drift.run(files, REPO, metrics_reg=metrics_reg)
+    assert any(f.rule == "MT002" and "ghost.metric" in f.message
+               for f in findings)
+
+
+def test_registry_unregistered_live_metric_detected():
+    """Dropping a metric from a registry copy makes its live call site
+    fire MT001 — the two-sided contract on the real tree."""
+    files = analysis.collect_py_files(REPO)
+    metrics_reg = dict(registry.METRICS)
+    del metrics_reg["egress.deadletter"]
+    findings = registry_drift.run(files, REPO, metrics_reg=metrics_reg,
+                                  full_scope=False)
+    assert any(f.rule == "MT001" and "egress.deadletter" in f.message
+               and f.path == "reporter_tpu/streaming/anonymiser.py"
+               for f in findings)
+
+
+def test_readme_knob_table_parser_reads_full_names():
+    readme = _read(os.path.join(REPO, "README.md"))
+    table = registry_drift.parse_readme_knobs(readme)
+    # the five knobs PR 6 closed the drift on are all table rows now
+    for name in ("REPORTER_TPU_CHAOS_REQUIRE_NATIVE",
+                 "REPORTER_TPU_NUM_PROCESSES",
+                 "REPORTER_TPU_PROBE_TRIES",
+                 "REPORTER_TPU_PROCESS_ID",
+                 "REPORTER_TPU_ROUTE_CACHE_PAIRS"):
+        assert name in table, f"{name} missing from README's knob table"
+
+
+# ---- fault coverage --------------------------------------------------------
+
+_FIXTURE_SITES = {"known.site": "fixture"}
+
+
+def _run_faultcov(name, relpath):
+    sf = _fixture(name, relpath)
+    findings = analysis.filter_suppressed(
+        fault_coverage.run([sf], REPO, sites=_FIXTURE_SITES,
+                           full_scope=False), [sf])
+    return sf, findings
+
+
+def test_faultcov_fires_on_bad_fixture():
+    sf, findings = _run_faultcov("faultcov_bad.py",
+                                 "reporter_tpu/streaming/fixture_bad.py")
+    _assert_matches_annotations(sf, findings, ("FP001",))
+
+
+def test_faultcov_silent_on_good_fixture():
+    _, findings = _run_faultcov("faultcov_good.py",
+                                "reporter_tpu/streaming/fixture_good.py")
+    assert findings == []
+
+
+def test_faultcov_registry_mirrors_known_sites():
+    import reporter_tpu.utils.faults as faults_mod
+    assert set(registry.FAULT_SITES) == set(faults_mod.KNOWN_SITES)
+
+
+def test_faultcov_live_drift_and_coverage_detected():
+    """Against the LIVE tree: an extra registry site fires FP001 (KNOWN_
+    SITES drift) + FP002 (no hook) + FP003 (no coverage); removing a
+    real site fires FP001 at its call sites."""
+    files = analysis.collect_py_files(REPO)
+    sites = dict(registry.FAULT_SITES, **{"ghost.site": "nothing"})
+    findings = fault_coverage.run(files, REPO, sites=sites)
+    rules = {f.rule for f in findings if "ghost.site" in f.message}
+    assert rules == {"FP001", "FP002", "FP003"}, \
+        [f.render() for f in findings]
+    sites = dict(registry.FAULT_SITES)
+    del sites["worker.offer"]
+    findings = fault_coverage.run(files, REPO, sites=sites)
+    assert any(f.rule == "FP001" and "worker.offer" in f.message
+               and f.path == "reporter_tpu/streaming/worker.py"
+               for f in findings)
+
+
+def test_faultcov_every_site_is_exercised():
+    """FP003's contract directly: every registered site appears in a
+    chaos scenario or a fault test (worker.post_egress was the gap this
+    pass surfaced; tests/test_faults.py now pins it)."""
+    files = analysis.collect_py_files(REPO)
+    findings = fault_coverage.run(files, REPO)
+    assert [f for f in findings if f.rule == "FP003"] == [], \
+        [f.render() for f in findings]
 
 
 # ---- ABI cross-check -------------------------------------------------------
@@ -283,9 +530,94 @@ def test_abi_parses_plain_int_and_typed_pointer_returns():
     assert "ABI001" in rules
 
 
-def test_list_rules_covers_all_four_passes():
+def test_list_rules_covers_all_passes():
     proc = _lint("--list-rules")
     assert proc.returncode == 0
     for rule in ("HP001", "HP002", "HP003", "JH001", "JH002", "JH003",
-                 "ABI001", "ABI004", "LD001"):
+                 "ABI001", "ABI004", "LD001", "LD002", "LD003",
+                 "DUR001", "DUR002", "DUR003", "DUR004",
+                 "KN001", "KN002", "MT001", "MT002",
+                 "FP001", "FP002", "FP003"):
         assert rule in proc.stdout
+
+
+def test_contracts_only_guard_is_clean_and_catches_drift(tmp_path):
+    """--contracts-only passes on the live tree and fails loudly when
+    README drops a knob row (the five-knob drift class, kept closed)."""
+    assert _lint("--contracts-only").returncode == 0
+    readme_path = os.path.join(REPO, "README.md")
+    readme = _read(readme_path)
+    target = "| `REPORTER_TPU_PROBE_TRIES` |"
+    assert target in readme, "README knob table drifted; update the test"
+    # simulate the drift in-process (the driver reads the real README,
+    # so exercise the pass directly on a mutated copy)
+    files = analysis.collect_py_files(
+        REPO, [os.path.join(REPO, "reporter_tpu"),
+               os.path.join(REPO, "tools"),
+               os.path.join(REPO, "bench.py")])
+    mutated = "\n".join(ln for ln in readme.splitlines()
+                        if not ln.startswith(target))
+    findings = registry_drift.run(files, REPO, readme_text=mutated)
+    assert any(f.rule == "KN002"
+               and "REPORTER_TPU_PROBE_TRIES" in f.message
+               for f in findings)
+
+
+def test_partial_run_skips_whole_package_contract_directions():
+    # a single-file run must not call registry entries "dead" just
+    # because their users are outside the requested paths
+    proc = _lint("reporter_tpu/matcher/matcher.py")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def _readme_rule_ids():
+    """Every rule id documented in README's Static-analysis table,
+    ranges expanded (``ABI001-005`` -> ABI001..ABI005)."""
+    readme = _read(os.path.join(REPO, "README.md"))
+    ids = set()
+    in_table = False
+    for line in readme.splitlines():
+        if line.startswith("| rule |"):
+            in_table = True
+            continue
+        if in_table:
+            if not line.startswith("|"):
+                break
+            cell = line.split("|")[1].strip()
+            m = re.match(r"^([A-Z]{2,3})(\d{3})(?:-(?:[A-Z]{2,3})?(\d{3}))?$",
+                         cell)
+            if not m:
+                continue
+            prefix, lo, hi = m.group(1), int(m.group(2)), m.group(3)
+            for n in range(lo, (int(hi) if hi else lo) + 1):
+                ids.add(f"{prefix}{n:03d}")
+    return ids
+
+
+def test_readme_rule_table_matches_the_suite():
+    """lint_fixtures self-check (ISSUE 6 satellite): every rule id
+    documented in README exists in the suite, and every implemented
+    rule is documented."""
+    documented = _readme_rule_ids()
+    implemented = set(analysis.ALL_RULES)
+    assert documented == implemented, (
+        f"README-only: {sorted(documented - implemented)}; "
+        f"undocumented: {sorted(implemented - documented)}")
+
+
+def test_every_rule_id_has_a_fixture_test():
+    """Every non-ABI rule id is exercised by a bad fixture annotation
+    (the ABI rules pin through the fixture .cpp/.py pair instead)."""
+    annotated = set()
+    for name in os.listdir(FIXTURES):
+        if not name.endswith(".py"):
+            continue
+        text = _read(os.path.join(FIXTURES, name))
+        annotated.update(re.findall(r"#\s*([A-Z]{2,3}\d{3})(?:\s*\(x\d+\))?:",
+                                    text))
+    # whole-package reverse directions (dead entries, README drift,
+    # coverage) are pinned by the live-tree tests above, not fixtures
+    full_scope_only = {"KN002", "MT002", "FP002", "FP003"}
+    missing = {r for r in analysis.ALL_RULES
+               if not r.startswith("ABI")} - full_scope_only - annotated
+    assert missing == set(), f"rules with no bad-fixture line: {missing}"

@@ -2,10 +2,14 @@
 """reporter-lint driver: run the project-native static-analysis suite.
 
 Usage:
-  python tools/lint.py                 # full suite over reporter_tpu/
-  python tools/lint.py --abi-only     # just the ctypes<->C++ ABI guard
-  python tools/lint.py --list-rules   # rule catalogue
-  python tools/lint.py path.py ...    # restrict the code passes to paths
+  python tools/lint.py                 # full suite over reporter_tpu/,
+                                       # tools/ and bench.py
+  python tools/lint.py --abi-only      # just the ctypes<->C++ ABI guard
+  python tools/lint.py --contracts-only  # just the cross-layer contract
+                                       # passes (registry/durability/
+                                       # lock-graph/fault-coverage)
+  python tools/lint.py --list-rules    # rule catalogue
+  python tools/lint.py path.py ...     # restrict the code passes to paths
 
 Exit status: 0 clean; 1 findings (or stale baseline entries); 2 usage /
 internal error. Output lines are ``file:line: RULE-ID message``.
@@ -13,8 +17,10 @@ internal error. Output lines are ``file:line: RULE-ID message``.
 Baseline workflow: findings listed verbatim in ``tools/lint_baseline.txt``
 are accepted (grandfathered) — but an entry that stops firing fails the
 run as *stale* so the file can only shrink honestly. ``--write-baseline``
-regenerates it from the current findings. ``--abi-only`` ignores the
-baseline entirely: an ABI mismatch is never acceptable debt.
+regenerates it from the current findings. ``--abi-only`` and
+``--contracts-only`` ignore the baseline entirely: an ABI mismatch or a
+registry/doc drift is never acceptable debt — fix the code, the
+registry, or README in the same commit.
 """
 from __future__ import annotations
 
@@ -30,6 +36,15 @@ from reporter_tpu.analysis import abi  # noqa: E402
 
 DEFAULT_BASELINE = os.path.join(REPO_ROOT, "tools", "lint_baseline.txt")
 
+#: the full-run scan scope: the package, the operational tooling, and
+#: the bench entry point (tools/ and bench.py read knobs and metrics
+#: too — the registry passes must see them)
+DEFAULT_ROOTS = (
+    os.path.join(REPO_ROOT, "reporter_tpu"),
+    os.path.join(REPO_ROOT, "tools"),
+    os.path.join(REPO_ROOT, "bench.py"),
+)
+
 
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
@@ -37,10 +52,15 @@ def main(argv=None) -> int:
         formatter_class=argparse.RawDescriptionHelpFormatter)
     parser.add_argument("paths", nargs="*",
                         help="files/dirs for the code passes "
-                             "(default: reporter_tpu/)")
+                             "(default: reporter_tpu/ tools/ bench.py)")
     parser.add_argument("--abi-only", action="store_true",
                         help="run only the ABI cross-check (pre-commit "
                              "guard; ignores the baseline)")
+    parser.add_argument("--contracts-only", action="store_true",
+                        help="run only the cross-layer contract passes "
+                             "(registry drift, fault coverage, "
+                             "durability, lock graph); ignores the "
+                             "baseline — fast pre-commit guard")
     parser.add_argument("--abi-cpp", default=None,
                         help="override the C++ runtime source path")
     parser.add_argument("--abi-py", default=None,
@@ -83,14 +103,39 @@ def main(argv=None) -> int:
         print("reporter-lint --abi-only: binding matches the C++ runtime")
         return 0
 
-    roots = [os.path.abspath(p) for p in args.paths] or None
+    if args.contracts_only:
+        files = analysis.collect_py_files(REPO_ROOT, DEFAULT_ROOTS)
+        findings = sorted(
+            analysis.filter_suppressed(
+                [*analysis.durability.run(files, REPO_ROOT),
+                 *analysis.lockgraph.run(files, REPO_ROOT)], files)
+            + analysis.run_contract_passes(files, REPO_ROOT))
+        for f in findings:
+            print(f.render())
+        if findings:
+            print(f"reporter-lint --contracts-only: {len(findings)} "
+                  "contract violation(s)", file=sys.stderr)
+            return 1
+        print(f"reporter-lint --contracts-only: contracts hold "
+              f"({len(files)} files)")
+        return 0
+
+    partial = bool(args.paths)
+    roots = [os.path.abspath(p) for p in args.paths] if partial \
+        else list(DEFAULT_ROOTS)
     files = analysis.collect_py_files(REPO_ROOT, roots)
     findings = analysis.run_code_passes(files, REPO_ROOT)
-    # the ABI pair is fixed infrastructure, checked on every full run
-    if roots is None:
-        findings = sorted(findings + abi_findings())
+    if not partial:
+        # whole-package-only checks: the ABI pair is fixed
+        # infrastructure, and the contract passes' reverse directions
+        # (dead entries, README drift, coverage) need every file in view
+        findings = sorted(findings + abi_findings()
+                          + analysis.run_contract_passes(files, REPO_ROOT))
+    else:
+        findings = sorted(findings + analysis.run_contract_passes(
+            files, REPO_ROOT, full_scope=False))
 
-    if args.write_baseline and roots is not None:
+    if args.write_baseline and partial:
         # a partial run sees a subset of findings; writing it out would
         # silently drop every grandfathered entry outside the paths
         print("error: --write-baseline requires a full run (no paths)",
@@ -110,7 +155,7 @@ def main(argv=None) -> int:
     baseline = [] if args.no_baseline \
         else analysis.load_baseline(args.baseline)
     new, stale = analysis.compare_baseline(findings, baseline)
-    if roots is not None:
+    if partial:
         # a partial run cannot judge staleness: entries for files outside
         # the requested paths legitimately did not fire this run
         stale = []
