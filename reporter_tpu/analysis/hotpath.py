@@ -55,6 +55,9 @@ HOT_PATH_PREFIXES = (
     "reporter_tpu/service/dispatch.py",
     "reporter_tpu/datastore/ingest.py",
     "reporter_tpu/datastore/aggregate.py",
+    # the observability layer rides every hot path above — a per-element
+    # loop here would tax every stage at once (ISSUE 7)
+    "reporter_tpu/obs/",
 )
 
 #: "relpath::qualname" -> why per-element Python is the contract there.

@@ -42,6 +42,9 @@ THREADED_PREFIXES = (
     "reporter_tpu/utils/faults.py",
     "reporter_tpu/utils/circuit.py",
     "reporter_tpu/native/__init__.py",
+    # span contexts / the flight-recorder ring are touched from every
+    # serving thread
+    "reporter_tpu/obs/",
 )
 
 _LOCKISH = re.compile(r"(^|_)(lock|mutex|mu)s?$", re.IGNORECASE)

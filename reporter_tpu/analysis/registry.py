@@ -56,6 +56,10 @@ ENV_KNOBS: Dict[str, str] = {
     "REPORTER_TPU_SUBMIT_RETRIES": "submit requeues before dead-letter",
     "REPORTER_TPU_WRITER_ID": "writer tag in epoch tile names",
     "REPORTER_TPU_CHAOS_REQUIRE_NATIVE": "chaos: missing native = fail",
+    "REPORTER_TPU_TRACE": "request tracing on/off (spans + export)",
+    "REPORTER_TPU_SLO_MS": "per-stage p99 budgets flipping /health",
+    "REPORTER_TPU_FLIGHTREC": "flight-recorder dump dir (0 disables)",
+    "REPORTER_TPU_HEARTBEAT_S": "worker heartbeat interval (0 off)",
 }
 
 # ---- metric names ----------------------------------------------------------
@@ -115,6 +119,8 @@ METRICS: Dict[str, str] = {
     "datastore.store.auto_compactions": "pressure-policy compactions",
     "datastore.query.cache.hits": "partition-handle LRU hits",
     "datastore.query.cache.misses": "partition-handle LRU misses",
+    # observability
+    "flightrec.dumps": "flight-recorder postmortems written",
 }
 
 # ---- failpoint sites -------------------------------------------------------
@@ -143,6 +149,9 @@ DURABLE_MODULES: Tuple[str, ...] = (
     "reporter_tpu/streaming/state.py",
     "reporter_tpu/streaming/anonymiser.py",
     "reporter_tpu/utils/fsio.py",
+    # the flight recorder dumps into the dead-letter layout — a torn
+    # postmortem after a crash would be worse than none
+    "reporter_tpu/obs/flightrec.py",
 )
 
 # ---- epoch-marker commit ordering (DUR004) ---------------------------------

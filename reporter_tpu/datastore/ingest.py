@@ -66,11 +66,13 @@ def parse_tile_csv(payload: str) -> ObservationBatch:
 
 
 def scan_tiles(root: str,
-               skip_names: tuple = (".deadletter", ".traces")) -> Iterator[str]:
+               skip_names: tuple = (".deadletter", ".traces",
+                                    ".flightrec")) -> Iterator[str]:
     """Yield tile file paths under an anonymiser output (or dead-letter)
     directory, skipping the dead-letter spool, the batcher's trace-JSON
-    spool (``.traces`` — request bodies, not tile CSV) and dot-state
-    files when scanning a results root."""
+    spool (``.traces`` — request bodies, not tile CSV), the flight
+    recorder's postmortem dumps (``.flightrec`` — span JSON) and
+    dot-state files when scanning a results root."""
     for dirpath, dirnames, filenames in os.walk(root):
         dirnames[:] = sorted(d for d in dirnames if d not in skip_names)
         for name in sorted(filenames):

@@ -27,6 +27,7 @@ from ..core.tracebatch import TraceBatch, as_trace_batch
 from ..graph.network import RoadNetwork
 from ..graph.route import RouteCache
 from ..graph.spatial import SpatialGrid
+from ..obs import trace as obs_trace
 from ..utils import faults, metrics
 from ..utils.circuit import CircuitBreaker
 from .assemble import assemble_segments
@@ -451,11 +452,16 @@ class SegmentMatcher:
         futures = []
         if pipeline_enabled():
             def submit(batch, order, sigma, beta):
+                # the device lanes run on their own threads: carry the
+                # chunk's trace context over the hop so decode/assemble
+                # spans parent to the chunk (None when disarmed)
+                ctx = obs_trace.current()
                 d_fut = self._dispatch_pool.submit(
-                    self._dispatch_stage, batch, sigma, beta, decode_batch)
+                    self._lane_stage, ctx, self._dispatch_stage, batch,
+                    sigma, beta, decode_batch)
                 futures.append((d_fut, self._drain_pool.submit(
-                    self._drain_stage, batch, order, d_fut,
-                    per_trace_params, results)))
+                    self._lane_stage, ctx, self._drain_stage, batch,
+                    order, d_fut, per_trace_params, results)))
         else:
             def submit(batch, order, sigma, beta):
                 decoded = self._dispatch_stage(batch, sigma, beta,
@@ -500,6 +506,13 @@ class SegmentMatcher:
         if first_err is not None:
             raise first_err
         return results
+
+    @staticmethod
+    def _lane_stage(ctx, fn, *args):
+        """Run one device-lane stage under a captured trace context (the
+        executor hop drops the submitter's contextvars)."""
+        with obs_trace.attach(ctx):
+            return fn(*args)
 
     def _dispatch_stage(self, batch, sigma, beta, decode_batch):
         """Dispatch lane: decode dispatch + async d2h for one chunk.
@@ -614,6 +627,7 @@ class SegmentMatcher:
         Ts = buckets[np.minimum(
             np.searchsorted(buckets, np.maximum(tb.lengths(), 1)),
             len(buckets) - 1)]
+        ci = 0  # chunk index across the whole call, a span attribute
         for params, idxs in self._param_groups(per_trace_params):
             sigma = np.float32(params.effective_sigma)
             beta = np.float32(params.beta)
@@ -626,30 +640,36 @@ class SegmentMatcher:
                     # (reporter-lint HP003)
                     order = part
                     rows = padded_batch_rows(len(part), pad)
-                    if not self.circuit.allow():
-                        metrics.count("matcher.circuit.fallback_chunks")
-                        self._submit_numpy_chunk(tb, part, params, pad,
-                                                 submit, sigma, beta)
-                        continue
-                    try:
-                        with metrics.timer("matcher.prep"):
-                            faults.failpoint("native.prep")
-                            batch = prepare_batch(
-                                self.runtime, tb.gather(part),
-                                params, int(T), pad_rows=rows,
-                                n_threads=workers)
-                    except Exception as e:
-                        self.circuit.record_failure()
-                        metrics.count("matcher.circuit.native_errors")
-                        logger.warning(
-                            "native prep failed for a %d-trace chunk "
-                            "(%s); serving it via the numpy fallback",
-                            len(part), e)
-                        self._submit_numpy_chunk(tb, part, params, pad,
-                                                 submit, sigma, beta)
-                        continue
-                    self.circuit.record_success()
-                    submit(batch, order, sigma, beta)
+                    with obs_trace.span("matcher.chunk", chunk=ci,
+                                        traces=len(part), T=int(T)):
+                        ci += 1
+                        if not self.circuit.allow():
+                            metrics.count(
+                                "matcher.circuit.fallback_chunks")
+                            self._submit_numpy_chunk(tb, part, params,
+                                                     pad, submit, sigma,
+                                                     beta)
+                            continue
+                        try:
+                            with metrics.timer("matcher.prep"):
+                                faults.failpoint("native.prep")
+                                batch = prepare_batch(
+                                    self.runtime, tb.gather(part),
+                                    params, int(T), pad_rows=rows,
+                                    n_threads=workers)
+                        except Exception as e:
+                            self.circuit.record_failure()
+                            metrics.count("matcher.circuit.native_errors")
+                            logger.warning(
+                                "native prep failed for a %d-trace chunk "
+                                "(%s); serving it via the numpy fallback",
+                                len(part), e)
+                            self._submit_numpy_chunk(tb, part, params,
+                                                     pad, submit, sigma,
+                                                     beta)
+                            continue
+                        self.circuit.record_success()
+                        submit(batch, order, sigma, beta)
 
     def _submit_numpy_chunk(self, tb: TraceBatch, part, params, pad,
                             submit, sigma, beta) -> None:
@@ -678,9 +698,14 @@ class SegmentMatcher:
         candidate search + per-trace route tensors through the shared
         cross-batch route cache, then pack_batches — same contract as the
         native path, slower."""
+        ci = 0
         for params, idxs in self._param_groups(per_trace_params):
             sigma = np.float32(params.effective_sigma)
             beta = np.float32(params.beta)
             for lo in range(0, len(idxs), chunk):
-                self._submit_numpy_chunk(tb, idxs[lo:lo + chunk], params,
-                                         pad, submit, sigma, beta)
+                part = idxs[lo:lo + chunk]
+                with obs_trace.span("matcher.chunk", chunk=ci,
+                                    traces=len(part)):
+                    ci += 1
+                    self._submit_numpy_chunk(tb, part, params, pad,
+                                             submit, sigma, beta)

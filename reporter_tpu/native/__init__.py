@@ -403,10 +403,16 @@ class NativeRuntime:
             out["num_kept"], out["dwell"], out["has_cands"],
             out["max_finite"], out["phase_ns"])
         from ..utils import metrics
-        for name, ns in zip(("candidates", "select", "routes"),
-                            out["phase_ns"].tolist()):
+        phase_ns = out["phase_ns"].tolist()
+        for name, ns in zip(("candidates", "select", "routes"), phase_ns):
             if ns > 0:
                 metrics.count(f"prep.phase.{name}_ns", ns)
+        # the same split as child spans of the enclosing matcher.prep
+        # span (no-op unless request tracing is armed): the ABI-11
+        # phase export doubles as the trace's prep breakdown
+        from ..obs import trace as obs_trace
+        obs_trace.phase_spans(
+            ("prep.candidates", "prep.select", "prep.routes"), phase_ns)
         return out
 
     def to_f16(self, arr: np.ndarray) -> np.ndarray:

@@ -1,0 +1,34 @@
+"""Observability: request tracing, latency histograms, Prometheus
+exposition, and the crash-surviving flight recorder.
+
+The reference's only telemetry is a 10k-message throughput log line
+(KeyedFormattingProcessor.java:36-38); SURVEY.md §5 lists
+tracing/profiling as an absent subsystem to build fresh. This package
+is that subsystem's second half (utils/metrics.py grew the histogram
+timers): per-request causality and a postmortem you can read after a
+crash.
+
+- :mod:`trace` — ``trace_id``/``span_id`` contexts (contextvar
+  propagated, ONE module-flag check when disarmed — the same discipline
+  as :mod:`..utils.faults`), spans threaded through the service,
+  dispatcher, matcher lanes, native prep phases and tile egress, and a
+  Chrome/Perfetto trace-event exporter.
+- :mod:`flightrec` — a bounded in-memory ring of recent span events,
+  dumped atomically (utils/fsio.py) to ``<deadletter>/.flightrec`` on
+  circuit-open, dead-letter spool, unhandled worker exceptions and
+  ``faults`` crash sites, so the postmortem names the exact span that
+  was in flight at SIGKILL.
+- :mod:`prom` — ``/metrics`` Prometheus text exposition rendered
+  straight from the metrics registry (counters -> ``_total``,
+  histogram timers -> ``_bucket``/``_sum``/``_count``).
+- :mod:`slo` — per-stage p99 targets (``REPORTER_TPU_SLO_MS``) that
+  flip ``/health`` degraded on breach.
+
+Import order matters: only the metrics-free modules load eagerly here
+(utils.metrics itself imports :mod:`trace` so every ``metrics.timer``
+site doubles as a span site); :mod:`prom` and :mod:`slo` depend on
+utils.metrics and are imported where used.
+"""
+from . import flightrec, trace  # noqa: F401
+
+__all__ = ["trace", "flightrec"]

@@ -1,0 +1,164 @@
+"""Flight recorder: a bounded ring of recent span events, dumped on
+failure so a crash leaves a postmortem.
+
+PR 5 built a whole chaos harness around crashing the worker, but the
+only evidence a SIGKILL'd process leaves is its state snapshot — what
+the process was *doing* at death is gone. This module keeps the last
+``RING_EVENTS`` closed spans and every still-open span in memory
+(populated by :mod:`.trace` whenever tracing is armed, zero cost
+otherwise) and dumps them atomically (:mod:`..utils.fsio` — a torn
+postmortem is worse than none) to ``<dump dir>/flightrec-<pid>-<seq>-
+<reason>.json`` at the failure sites that matter:
+
+- ``faults`` crash failpoints, immediately before ``os._exit`` — the
+  dump's ``in_flight`` list names the exact span the SIGKILL landed in
+  (asserted by the chaos harness's kill/restore scenario)
+- circuit-breaker open transitions (utils/circuit.py)
+- dead-letter spools (a tile body or trace JSON headed for the spool
+  means an outage worth a postmortem)
+- unhandled streaming-worker exceptions
+
+The dump directory defaults to ``.flightrec`` under the worker's
+dead-letter spool (set by :class:`~..streaming.worker.StreamWorker`);
+``REPORTER_TPU_FLIGHTREC`` overrides it with an explicit directory, or
+disables dumping outright with ``0``. With no directory resolved,
+dumps are skipped — the ring still serves the ``?trace=1`` exporter.
+"""
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import time
+from typing import Deque, Dict, List, Optional
+
+from ..utils import fsio
+
+ENV_VAR = "REPORTER_TPU_FLIGHTREC"
+
+#: closed-span ring capacity; at ~14 spans per request this is the last
+#: ~290 requests of context — enough to see what led up to a failure,
+#: and enough that one ``?trace=1`` request's own spans survive a busy
+#: server's concurrent traffic until export (the ring is process-global;
+#: a request overlapped by more than ~290 others exports best-effort)
+RING_EVENTS = 4096
+
+#: deques are append-thread-safe; only the open-span table and the
+#: dump bookkeeping need the lock
+_ring: Deque[dict] = collections.deque(maxlen=RING_EVENTS)
+_open: Dict[int, dict] = {}
+_lock = threading.Lock()
+_dump_dir: Optional[str] = None
+_dir_from_env = False
+_disabled = False
+_seq = 0
+
+
+def _configure_env() -> None:
+    global _dump_dir, _dir_from_env, _disabled
+    val = os.environ.get(ENV_VAR, "").strip()
+    with _lock:
+        if val.lower() in ("0", "off", "false"):
+            _disabled = True
+        elif val:
+            _dump_dir = val
+            _dir_from_env = True
+
+
+def set_dump_dir(path: str) -> None:
+    """Adopt a dump directory (the worker's ``<deadletter>/.flightrec``)
+    unless the environment already pinned one — an operator override
+    must win over the derived default."""
+    global _dump_dir
+    with _lock:
+        if not _dir_from_env:
+            _dump_dir = path
+
+
+def dump_dir() -> Optional[str]:
+    with _lock:
+        return None if _disabled else _dump_dir
+
+
+# ---- ring maintenance (called by trace.py, armed only) ---------------------
+
+def span_opened(span_id: int, record: dict) -> None:
+    with _lock:
+        _open[span_id] = record
+
+
+def span_closed(span_id: int, dur_ns: int) -> None:
+    with _lock:
+        record = _open.pop(span_id, None)
+    if record is not None:
+        record["dur_ns"] = dur_ns
+        _ring.append(record)
+
+
+def record_closed(records: List[dict]) -> None:
+    """Append already-closed span records (synthetic phase spans)."""
+    _ring.extend(records)
+
+
+def events() -> List[dict]:
+    """Closed spans, oldest first (a snapshot copy)."""
+    return list(_ring)
+
+
+def in_flight() -> List[dict]:
+    """Open spans right now, with their age stamped in."""
+    now_ns = time.time_ns()
+    with _lock:
+        open_now = list(_open.values())
+    return [{**r, "age_ns": max(0, now_ns - r["t0_ns"])} for r in open_now]
+
+
+def reset() -> None:
+    """Drop ring + open table (tests)."""
+    with _lock:
+        _open.clear()
+    _ring.clear()
+
+
+# ---- the postmortem --------------------------------------------------------
+
+def dump(reason: str, extra: Optional[dict] = None) -> Optional[str]:
+    """Write the postmortem; returns its path, or None when disabled or
+    no dump directory is resolved. Never raises — every caller is
+    already on a failure path (one of them is about to ``os._exit``)."""
+    global _seq
+    try:
+        with _lock:
+            if _disabled or _dump_dir is None:
+                return None
+            _seq += 1
+            seq = _seq
+            out_dir = _dump_dir
+        safe = "".join(c if c.isalnum() or c in "._-" else "_"
+                       for c in reason)[:80]
+        payload = {
+            "reason": reason,
+            "pid": os.getpid(),
+            "ts_ns": time.time_ns(),
+            "in_flight": in_flight(),
+            "spans": events(),
+        }
+        if extra:
+            payload["extra"] = extra
+        from ..utils import metrics  # lazy: metrics imports obs.trace
+        # export_state's counter copy, not snapshot(): no percentile
+        # math on a failure path that may be racing an os._exit
+        payload["counters"] = metrics.default.export_state()[0]
+        os.makedirs(out_dir, exist_ok=True)
+        path = os.path.join(out_dir,
+                            f"flightrec-{os.getpid()}-{seq:04d}-{safe}.json")
+        fsio.atomic_write_text(path, json.dumps(payload,
+                                                separators=(",", ":")))
+        metrics.count("flightrec.dumps")
+        return path
+    except Exception:  # pragma: no cover - postmortem must never kill
+        return None
+
+
+_configure_env()

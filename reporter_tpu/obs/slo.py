@@ -1,0 +1,90 @@
+"""Per-stage latency SLOs: p99 targets that flip /health degraded.
+
+``REPORTER_TPU_SLO_MS`` declares targets as a comma-separated spec::
+
+    service.handle=250,matcher.prep=50,dispatch.match_many=120
+
+Each entry names a stage timer and its p99 budget in milliseconds. The
+/health probe calls :func:`check`; a stage whose histogram p99 exceeds
+its budget is a breach, and any breach turns /health 503 — the same
+load-balancer rotate-away signal an open circuit sends, but driven by
+the latency distribution instead of hard failures (a stage can be
+"working" and still 10x over budget).
+
+A malformed spec is reported in the check result and logged, but never
+degrades health by itself — a typo'd SLO string must not rotate a
+healthy fleet out of service (the same fail-open posture as a typo'd
+``REPORTER_TPU_FAULTS`` spec staying disarmed).
+"""
+from __future__ import annotations
+
+import logging
+import os
+from typing import Dict, Optional
+
+from ..utils import metrics
+
+logger = logging.getLogger("reporter_tpu.obs")
+
+ENV_VAR = "REPORTER_TPU_SLO_MS"
+
+_cache_spec: Optional[str] = None
+_cache_parsed: Dict[str, float] = {}
+
+
+def parse_spec(spec: str) -> Dict[str, float]:
+    """``stage=ms[,stage=ms...]`` -> {stage: budget seconds}; raises
+    ValueError on any malformed entry."""
+    out: Dict[str, float] = {}
+    for entry in spec.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        stage, sep, ms = entry.partition("=")
+        if not sep or not stage.strip():
+            raise ValueError(f"bad SLO entry {entry!r} (want stage=ms)")
+        try:
+            budget_ms = float(ms)
+        except ValueError:
+            raise ValueError(f"bad SLO budget in {entry!r} "
+                             "(want milliseconds)") from None
+        if budget_ms <= 0:
+            raise ValueError(f"SLO budget must be > 0 in {entry!r}")
+        out[stage.strip()] = budget_ms / 1000.0
+    return out
+
+
+def thresholds() -> Dict[str, float]:
+    """The armed targets from the environment ({} when unset); the
+    parse is cached per spec string (health probes are frequent)."""
+    global _cache_spec, _cache_parsed
+    spec = os.environ.get(ENV_VAR, "")
+    if spec == _cache_spec:
+        return _cache_parsed
+    try:
+        parsed = parse_spec(spec) if spec else {}
+    except ValueError as e:
+        logger.error("ignoring malformed %s=%r: %s", ENV_VAR, spec, e)
+        parsed = {}
+    _cache_spec, _cache_parsed = spec, parsed
+    return parsed
+
+
+def check(registry: Optional[metrics.Registry] = None) -> dict:
+    """{"targets": {stage: budget_s}, "breaches": [...]} — a breach is
+    a stage whose histogram p99 exceeds its budget. Stages with no
+    observations yet never breach (an idle stage is not a slow one)."""
+    targets = thresholds()
+    if not targets:
+        return {"targets": {}, "breaches": []}
+    snap = (registry if registry is not None
+            else metrics.default).snapshot()["timers"]
+    breaches = [
+        {"stage": stage,
+         "p99_s": round(snap[stage]["p99_s"], 6),
+         "slo_s": budget_s,
+         "count": snap[stage]["count"]}
+        for stage, budget_s in sorted(targets.items())
+        if stage in snap and snap[stage]["count"]
+        and snap[stage]["p99_s"] > budget_s]
+    return {"targets": targets, "breaches": breaches}

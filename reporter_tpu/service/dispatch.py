@@ -18,11 +18,12 @@ import time
 from typing import Callable, List, Optional, Sequence
 
 from ..core.tracebatch import TraceBatch
+from ..obs import trace as obs_trace
 from ..utils import metrics
 
 
 class _Slot:
-    __slots__ = ("trace", "columns", "event", "result", "error")
+    __slots__ = ("trace", "columns", "event", "result", "error", "ctx")
 
     def __init__(self, trace, columns: Optional[tuple] = None):
         self.trace = trace
@@ -32,6 +33,10 @@ class _Slot:
         # dicts — a whole-batch of columnar slots reaches the matcher as
         # ONE TraceBatch with zero per-point Python in the dispatch loop
         self.columns = columns
+        # the submitter's trace context: the dispatch loop runs on its
+        # own thread, so request causality must ride the slot (None —
+        # one flag check — when tracing is disarmed)
+        self.ctx = obs_trace.current()
         self.event = threading.Event()
         self.result: Optional[dict] = None
         self.error: Optional[Exception] = None
@@ -58,6 +63,7 @@ class BatchDispatcher:
         # for a steady trickle of arrivals
         self.idle_grace = min(idle_grace_ms / 1000.0, self.max_wait)
         self._queue: "queue.Queue[_Slot]" = queue.Queue()
+        self._batches = 0  # batch sequence, stamped on batch spans
         self._closed = False
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="match-dispatch")
@@ -150,20 +156,35 @@ class BatchDispatcher:
     def _loop(self):
         while not self._closed:
             slots = self._drain_batch()
+            self._batches += 1
             metrics.count("dispatch.batches")
             metrics.count("dispatch.traces", len(slots))
+            # adopt one submitter's trace context so the batch's stage
+            # spans parent to that request (a merged batch can only
+            # follow one requester; the batch attrs record the merge)
+            ctx = None
+            for s in slots:
+                if s.ctx is not None:
+                    ctx = s.ctx
+                    break
             try:
-                # a batch of columnar slots concatenates into ONE
-                # TraceBatch (flat arrays, no per-point Python); plain
-                # dict submissions fall back to the request-dict path
-                if all(s.columns is not None for s in slots):
-                    batch = TraceBatch.concat([s.columns for s in slots])
-                else:
-                    batch = [s.trace for s in slots]
-                with metrics.timer("dispatch.match_many"):
-                    results = self._match_many(batch)
-                for slot, res in zip(slots, results):
-                    slot.result = res
+                with obs_trace.attach(ctx), \
+                        obs_trace.span("dispatch.batch",
+                                       batch=self._batches,
+                                       traces=len(slots)):
+                    # a batch of columnar slots concatenates into ONE
+                    # TraceBatch (flat arrays, no per-point Python);
+                    # plain dict submissions fall back to the
+                    # request-dict path
+                    if all(s.columns is not None for s in slots):
+                        batch = TraceBatch.concat(
+                            [s.columns for s in slots])
+                    else:
+                        batch = [s.trace for s in slots]
+                    with metrics.timer("dispatch.match_many"):
+                        results = self._match_many(batch)
+                    for slot, res in zip(slots, results):
+                        slot.result = res
             except Exception as e:  # propagate to every waiter in the batch
                 metrics.count("dispatch.errors")
                 for slot in slots:

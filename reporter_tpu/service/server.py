@@ -31,17 +31,21 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from ..core.tracebatch import points_to_columns
 from ..matcher import Configure, SegmentMatcher
+from ..obs import trace as obs_trace
 from ..utils import metrics
 from .dispatch import BatchDispatcher
 from .report import report, report_json
 
 # /report is the reference's only action (reporter_service.py:26);
-# /stats is new — a metrics snapshot (counters + stage timers);
+# /stats is new — a metrics snapshot (counters + stage-timer
+# histograms: count/total/mean/max + p50/p95/p99);
+# /metrics is the same registry in Prometheus exposition text;
 # /histogram is the datastore query surface (datastore/query.py), live
 # when the service was built with a datastore attached;
 # /health is the failure-domain probe: graph, native runtime vs numpy
-# fallback, circuit state, datastore reachability — 200 or 503
-ACTIONS = {"report", "stats", "histogram", "health"}
+# fallback, circuit state, SLO breaches, datastore reachability —
+# 200 or 503
+ACTIONS = {"report", "stats", "metrics", "histogram", "health"}
 
 
 class ReporterService:
@@ -94,8 +98,9 @@ class ReporterService:
             # columnar response writer: serialise the whole response
             # straight from the match's run columns — the per-trace
             # report/segment dicts never exist on this path
-            return 200, report_json(match, trace, self.threshold_sec,
-                                    report_levels, transition_levels)
+            with obs_trace.span("report.serialise"):
+                return 200, report_json(match, trace, self.threshold_sec,
+                                        report_levels, transition_levels)
         except Exception as e:
             return 500, json.dumps({"error": str(e)})
 
@@ -134,9 +139,12 @@ class ReporterService:
         200 means fully serving: graph loaded and the datastore (when
         attached) reachable. 503 flags a degraded domain a load balancer
         should rotate away from: the native-prep circuit OPEN (still
-        serving, via the numpy fallback, but slower) or the datastore
-        erroring. The body always enumerates every domain either way.
+        serving, via the numpy fallback, but slower), a stage whose p99
+        breaches its ``REPORTER_TPU_SLO_MS`` budget (working, but over
+        latency budget), or the datastore erroring. The body always
+        enumerates every domain either way.
         """
+        from ..obs import slo
         from ..utils import faults
         m = self.matcher
         circuit = m.circuit.snapshot()
@@ -151,6 +159,12 @@ class ReporterService:
         }
         healthy = True
         if circuit["state"] == "open":
+            healthy = False
+        slo_check = slo.check()
+        body["slo"] = {"targets": {k: round(v * 1000.0, 3) for k, v
+                                   in slo_check["targets"].items()},
+                       "breaches": slo_check["breaches"]}
+        if slo_check["breaches"]:
             healthy = False
         if self.datastore is None:
             body["datastore"] = {"status": "absent"}
@@ -214,14 +228,15 @@ def make_handler(service: ReporterService):
                 return json.loads(params["json"][0])
             raise ValueError("No json provided")
 
-        def _respond(self, code: int, body: str):
+        def _respond(self, code: int, body: str,
+                     content_type: str = "application/json;charset=utf-8"):
             raw = body.encode("utf-8")
             # one request per connection, like the reference's HTTP/1.0
             # service — keep-alive would pin a bounded pool slot idle
             self.close_connection = True
             self.send_response(code)
             self.send_header("Access-Control-Allow-Origin", "*")
-            self.send_header("Content-type", "application/json;charset=utf-8")
+            self.send_header("Content-type", content_type)
             self.send_header("Content-length", str(len(raw)))
             self.end_headers()
             self.wfile.write(raw)
@@ -248,9 +263,17 @@ def make_handler(service: ReporterService):
             return out
 
         def _do(self, post: bool):
-            action = urllib.parse.urlsplit(self.path).path.split("/")[-1]
+            split = urllib.parse.urlsplit(self.path)
+            action = split.path.split("/")[-1]
             if action == "stats":
-                self._respond(200, json.dumps(metrics.snapshot()))
+                # the wire writer owns the rounding (snapshot() reports
+                # raw floats so sub-µs stages don't collapse to 0.0)
+                self._respond(200, json.dumps(metrics.snapshot_rounded()))
+                return
+            if action == "metrics":
+                from ..obs import prom
+                self._respond(200, prom.render(),
+                              content_type=prom.CONTENT_TYPE)
                 return
             if action == "health":
                 code, body = service.health()
@@ -271,14 +294,36 @@ def make_handler(service: ReporterService):
                     metrics.count(f"service.errors.{code}")
                 self._respond(code, body)
                 return
+            # ?trace=1 debug flag: arm tracing for this request and ship
+            # the request's span tree (Chrome/Perfetto trace-event JSON)
+            # alongside the report body
+            qs = urllib.parse.parse_qs(split.query)
+            # same falsy spellings as REPORTER_TPU_TRACE env parsing
+            want_trace = qs.get("trace", ["0"])[0].lower() \
+                not in ("", "0", "off", "false")
+            if want_trace:
+                obs_trace.force_begin()
             try:
-                trace = self._parse(post)
-            except Exception as e:
-                self._respond(400, json.dumps({"error": str(e)}))
-                return
-            metrics.count("service.requests")
-            with metrics.timer("service.handle"):
-                code, body = service.handle(trace)
+                # the root span: one per /report request, covering parse
+                # -> dispatch -> match -> serialisation, so every stage
+                # span below it shares the request's trace_id
+                with obs_trace.span("service.request") as root:
+                    try:
+                        with obs_trace.span("service.parse"):
+                            trace = self._parse(post)
+                    except Exception as e:
+                        self._respond(400, json.dumps({"error": str(e)}))
+                        return
+                    metrics.count("service.requests")
+                    with metrics.timer("service.handle"):
+                        code, body = service.handle(trace)
+                if want_trace and code == 200:
+                    body = ('{"report":' + body + ',"trace":'
+                            + json.dumps(obs_trace.export_trace(root),
+                                         separators=(",", ":")) + "}")
+            finally:
+                if want_trace:
+                    obs_trace.force_end()
             if code != 200:
                 metrics.count(f"service.errors.{code}")
             self._respond(code, body)

@@ -25,6 +25,8 @@ import tempfile
 from typing import Dict, List, Optional
 
 from ..core.types import Segment, TimeQuantisedTile
+from ..obs import flightrec
+from ..obs import trace as obs_trace
 from ..utils import faults, fsio
 from ..utils import http as http_egress
 from ..utils import metrics
@@ -137,6 +139,10 @@ class TileSink:
             metrics.count("egress.deadletter")
             logger.warning("Spooled failed tile to %s/%s/%s",
                            self.deadletter, tile_name, file_name)
+            # a tile in the spool means the sink is failing: leave a
+            # postmortem of what led up to it
+            flightrec.dump("deadletter.tile",
+                           {"tile": tile_name, "file": file_name})
         except Exception as e:  # spool is best-effort: never raise
             logger.error("Dead-letter spool failed for %s/%s: %s",
                          tile_name, file_name, e)
@@ -217,40 +223,50 @@ class Anonymiser:
         written = 0
         epoch = self.flush_epoch
         file_name = self.epoch_file_name(epoch)
-        for tile, max_slice in list(self.slice_of.items()):
-            del self.slice_of[tile]
-            segments: List[Segment] = []
-            for i in range(max_slice + 1):
-                name = f"{tile}.{i}"
-                part = self.slices.pop(name, None)
-                if part is not None:
-                    segments.extend(part)
-                else:
-                    logger.warning("Missing quantised tile slice %s", name)
-            segments.sort(key=Segment.sort_key)
-            before = len(segments)
-            segments = privacy_cull(segments, self.privacy)
-            logger.info("Anonymised quantised tile %s from %d to %d segments",
-                        tile, before, len(segments))
-            if not segments:
-                continue
-            if self.tee is not None:
-                try:
-                    self.tee(tile, segments)
-                except Exception as e:
-                    logger.error("datastore tee failed for tile %s: %s",
-                                 tile, e)
-            payload = "\n".join(
-                [Segment.column_layout()]
-                + [s.csv_row(self.mode, self.source) for s in segments])
-            tile_name = "{}_{}/{}/{}".format(
-                tile.time_range_start,
-                tile.time_range_start + self.quantisation - 1,
-                tile.tile_level(), tile.tile_index())
-            logger.info("Writing tile to %s/%s/%s with %d segments",
-                        self.sink.output, tile_name, file_name, len(segments))
-            if self.sink.store(tile_name, file_name, payload):
-                written += 1
+        # the flush span carries the epoch; a tile file on disk names
+        # its epoch too, so the file is traceable back to this span —
+        # and through its parents to the requests that fed it
+        with obs_trace.span("egress.flush", epoch=epoch):
+            for tile, max_slice in list(self.slice_of.items()):
+                del self.slice_of[tile]
+                segments: List[Segment] = []
+                for i in range(max_slice + 1):
+                    name = f"{tile}.{i}"
+                    part = self.slices.pop(name, None)
+                    if part is not None:
+                        segments.extend(part)
+                    else:
+                        logger.warning("Missing quantised tile slice %s",
+                                       name)
+                segments.sort(key=Segment.sort_key)
+                before = len(segments)
+                segments = privacy_cull(segments, self.privacy)
+                logger.info(
+                    "Anonymised quantised tile %s from %d to %d segments",
+                    tile, before, len(segments))
+                if not segments:
+                    continue
+                if self.tee is not None:
+                    try:
+                        self.tee(tile, segments)
+                    except Exception as e:
+                        logger.error("datastore tee failed for tile %s: %s",
+                                     tile, e)
+                payload = "\n".join(
+                    [Segment.column_layout()]
+                    + [s.csv_row(self.mode, self.source) for s in segments])
+                tile_name = "{}_{}/{}/{}".format(
+                    tile.time_range_start,
+                    tile.time_range_start + self.quantisation - 1,
+                    tile.tile_level(), tile.tile_index())
+                logger.info("Writing tile to %s/%s/%s with %d segments",
+                            self.sink.output, tile_name, file_name,
+                            len(segments))
+                with obs_trace.span("egress.tile", epoch=epoch,
+                                    tile=tile_name):
+                    ok = self.sink.store(tile_name, file_name, payload)
+                if ok:
+                    written += 1
         # drop unreferenced slices (reference: :258-265)
         for name in list(self.slices):
             logger.warning("Deleting unreferenced quantised tile slice %s",

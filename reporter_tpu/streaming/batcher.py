@@ -40,6 +40,8 @@ from ..core.geo import equirectangular_m
 from ..core.osmlr import INVALID_SEGMENT_ID
 from ..core.tracebatch import TraceBatch, TraceView
 from ..core.types import Point, Segment
+from ..obs import flightrec
+from ..obs import trace as obs_trace
 from ..utils import faults, metrics
 
 logger = logging.getLogger("reporter_tpu.streaming")
@@ -302,22 +304,23 @@ class PointBatcher:
         kill the stream thread nor silently lose the trace."""
         if not due:
             return
-        tb = TraceBatch.concat([
-            batch.request_columns(uuid, self.options)
-            for uuid, batch in due])
-        try:
-            faults.failpoint("matcher.submit")
-            responses = self.submit_many(tb)
-        except Exception as e:
-            logger.error("batched submit failed for %d traces: %s",
-                         len(due), e)
-            responses = [None] * len(due)
-        for (uuid, batch), response in zip(due, responses):
-            if response is None:
-                self._submit_failed(uuid, batch)
-                continue
-            batch.retries = 0
-            self._forward_all(batch.apply_response(uuid, response))
+        with obs_trace.span("batcher.flush", sessions=len(due)):
+            tb = TraceBatch.concat([
+                batch.request_columns(uuid, self.options)
+                for uuid, batch in due])
+            try:
+                faults.failpoint("matcher.submit")
+                responses = self.submit_many(tb)
+            except Exception as e:
+                logger.error("batched submit failed for %d traces: %s",
+                             len(due), e)
+                responses = [None] * len(due)
+            for (uuid, batch), response in zip(due, responses):
+                if response is None:
+                    self._submit_failed(uuid, batch)
+                    continue
+                batch.retries = 0
+                self._forward_all(batch.apply_response(uuid, response))
 
     def _submit_failed(self, uuid: str, batch: Batch) -> None:
         """One failed round trip: requeue a live batch under the budget,
@@ -361,6 +364,9 @@ class PointBatcher:
             os.replace(path + ".tmp", path)
             metrics.count("batch.deadletter")
             logger.warning("Dead-lettered trace for %s -> %s", uuid, path)
+            # a dead-lettered trace means the matcher stayed down past
+            # the retry budget — postmortem what was in flight
+            flightrec.dump("deadletter.trace", {"uuid": uuid})
         except Exception as e:
             logger.error("Trace dead-letter spool failed for %s: %s",
                          uuid, e)

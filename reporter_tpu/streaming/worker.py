@@ -30,6 +30,8 @@ import sys
 import time
 from typing import Callable, Iterable, Optional
 
+from ..obs import flightrec
+from ..obs import trace as obs_trace
 from ..utils import faults, metrics
 from ..utils import http as http_egress
 from .anonymiser import Anonymiser, TileSink
@@ -82,7 +84,8 @@ class StreamWorker:
                  uuid_filter: Optional[Callable[[str], bool]] = None,
                  submit_many=None,
                  report_flush_interval_s: float = 1.0,
-                 trace_deadletter: Optional[str] = None):
+                 trace_deadletter: Optional[str] = None,
+                 circuit_probe: Optional[Callable[[], str]] = None):
         self.formatter = formatter
         # multi-host: predicate deciding which uuids this worker owns
         # (parallel.multihost — the Kafka keyed-partition contract when the
@@ -90,15 +93,20 @@ class StreamWorker:
         self.uuid_filter = uuid_filter
         self.skipped_other_host = 0
         self.anonymiser = anonymiser
+        spool = getattr(getattr(anonymiser, "sink", None),
+                        "deadletter", None)
         if trace_deadletter is None:
             # default next to the tile dead-letter spool, dot-prefixed so
             # `datastore ingest` over that spool never mistakes a trace
             # JSON for a tile CSV (ingest.scan_tiles skips it by name);
             # stub sinks without a spool leave it off (log-and-drop)
-            spool = getattr(getattr(anonymiser, "sink", None),
-                            "deadletter", None)
             if spool:
                 trace_deadletter = os.path.join(spool, ".traces")
+        # the flight recorder dumps its postmortems next to the spools
+        # (same layout contract: dot-prefixed, skipped by scan_tiles);
+        # an explicit REPORTER_TPU_FLIGHTREC wins inside set_dump_dir
+        if spool:
+            flightrec.set_dump_dir(os.path.join(spool, ".flightrec"))
         self.batcher = PointBatcher(
             submit, lambda key, seg: self.anonymiser.process(key, seg),
             mode=mode, report_on=reports, transition_on=transitions,
@@ -117,6 +125,18 @@ class StreamWorker:
         # while a fast replay still accumulates whole device batches
         self.report_flush_interval_s = report_flush_interval_s
         self._last_report_flush = clock()
+        # structured heartbeat: the reference logged a bare counter every
+        # 10k messages (KeyedFormattingProcessor.java:36-38); this one is
+        # wall-clock paced (monotonic — independent of injected replay
+        # clocks) and single-line JSON so a log pipeline can chart it.
+        # 0 disables.
+        from ..utils.runtime import _env_float
+        self.heartbeat_s = _env_float("REPORTER_TPU_HEARTBEAT_S", 0.0)
+        # circuit-state probe for the heartbeat (in-process deployments
+        # pass the matcher's breaker; HTTP splits have none to read)
+        self.circuit_probe = circuit_probe
+        self._hb_last = time.monotonic()
+        self._hb_processed = 0
         # durable state (StateStore): restore open batches + tile slices
         # from the last snapshot — the reference instead loses in-memory
         # state on crash (BatchingProcessor.java:20-22, SURVEY.md §5)
@@ -126,25 +146,32 @@ class StreamWorker:
 
     def offer(self, message: str) -> None:
         """One raw message through the topology."""
-        # chaos hook: lets a harness kill the worker at an exact stream
-        # position ("crash at the Nth offer") — one flag check when off
-        faults.failpoint("worker.offer")
-        now_ms = int(self.clock() * 1000)
-        try:
-            uuid, point = self.formatter.format(message)
-        except Exception:
-            self.parse_failures += 1
-            if self.parse_failures % 1000 == 1:
-                logger.warning("Could not parse message: %r", message[:200])
-            return
-        if self.uuid_filter is not None and not self.uuid_filter(uuid):
-            self.skipped_other_host += 1
-            return
-        self.batcher.process(uuid, point, now_ms)
-        self.processed += 1
-        if self.processed % 10000 == 0:
-            logger.info("Processed %d messages", self.processed)
-        self.maybe_punctuate()
+        # the per-message span (no-op flag check unless tracing is
+        # armed) opens BEFORE the crash failpoint so a SIGKILL-grade
+        # death lands inside it — the flight-recorder postmortem then
+        # names this exact span as in flight
+        with obs_trace.span("worker.offer"):
+            # chaos hook: lets a harness kill the worker at an exact
+            # stream position ("crash at the Nth offer") — one flag
+            # check when off
+            faults.failpoint("worker.offer")
+            now_ms = int(self.clock() * 1000)
+            try:
+                uuid, point = self.formatter.format(message)
+            except Exception:
+                self.parse_failures += 1
+                if self.parse_failures % 1000 == 1:
+                    logger.warning("Could not parse message: %r",
+                                   message[:200])
+                return
+            if self.uuid_filter is not None and not self.uuid_filter(uuid):
+                self.skipped_other_host += 1
+                return
+            self.batcher.process(uuid, point, now_ms)
+            self.processed += 1
+            if self.processed % 10000 == 0:
+                logger.info("Processed %d messages", self.processed)
+            self.maybe_punctuate()
 
     def maybe_punctuate(self, force: bool = False) -> None:
         now = self.clock()
@@ -176,6 +203,33 @@ class StreamWorker:
             except Exception as e:
                 metrics.count("state.save.fail")
                 logger.error("state snapshot failed (will retry): %s", e)
+        if self.heartbeat_s > 0:
+            self._maybe_heartbeat()
+
+    def _maybe_heartbeat(self) -> None:
+        """Emit the structured heartbeat when its wall interval elapsed:
+        one JSON line with throughput, in-flight state, the flush epoch
+        and the circuit state — the reference's 10k counter, made
+        chartable. Paced by message arrival (the worker is single
+        threaded by design): a stalled input emits none, which IS the
+        stall signal — no background thread to lock against."""
+        now = time.monotonic()
+        dt = now - self._hb_last
+        if dt < self.heartbeat_s:
+            return
+        rate = (self.processed - self._hb_processed) / dt if dt > 0 else 0.0
+        self._hb_last = now
+        self._hb_processed = self.processed
+        logger.info("heartbeat %s", json.dumps({
+            "processed": self.processed,
+            "msgs_per_s": round(rate, 1),
+            "batches_in_flight": len(self.batcher.store),
+            "pending_reports": len(self.batcher.pending),
+            "flush_epoch": self.anonymiser.flush_epoch,
+            "circuit": self.circuit_probe() if self.circuit_probe
+            else None,
+            "parse_failures": self.parse_failures,
+        }, separators=(",", ":")))
 
     def _flush_tiles(self) -> None:
         """Tile egress bracketed by durability barriers.
@@ -227,10 +281,16 @@ class StreamWorker:
     def run(self, messages: Iterable[str],
             duration_s: Optional[float] = None) -> None:
         deadline = self.clock() + duration_s if duration_s else None
-        for message in messages:
-            self.offer(message)
-            if deadline is not None and self.clock() > deadline:
-                break
+        try:
+            for message in messages:
+                self.offer(message)
+                if deadline is not None and self.clock() > deadline:
+                    break
+        except Exception as e:
+            # an unhandled exception is about to kill the stream: leave
+            # a postmortem naming the span that was in flight
+            flightrec.dump("worker.exception", {"error": repr(e)})
+            raise
         self.drain()
 
 
@@ -338,6 +398,7 @@ def main(argv=None):
     init_multihost()
     uuid_filter = resolve_uuid_filter(args.uuid_filter, args.bootstrap)
 
+    circuit_probe = None
     if args.reporter_url:
         submit = http_submitter(args.reporter_url)
         submit_many = None  # HTTP path: one POST per trace (split deploy)
@@ -353,6 +414,7 @@ def main(argv=None):
         # batched submit for eviction flushes: one dispatcher round trip
         # -> one padded device batch (ReporterService.report_many)
         submit_many = service.report_many
+        circuit_probe = lambda: service.matcher.circuit.state  # noqa: E731
 
     state = None
     if args.state_file:
@@ -385,7 +447,8 @@ def main(argv=None):
         mode=args.mode, reports=args.reports, transitions=args.transitions,
         flush_interval_s=args.flush_interval, state=state,
         uuid_filter=uuid_filter, submit_many=submit_many,
-        report_flush_interval_s=args.report_flush_interval)
+        report_flush_interval_s=args.report_flush_interval,
+        circuit_probe=circuit_probe)
 
     # the flat-file input is opened under an ExitStack so the handle
     # closes on every exit path (drain, exception, --duration cut-off)

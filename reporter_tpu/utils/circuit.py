@@ -99,6 +99,11 @@ class CircuitBreaker:
         self._registry.count(f"{self.name}.failures")
         if opened_now:
             self._registry.count(f"{self.name}.opened")
+            # an opening circuit is the moment an operator will ask
+            # "what was happening?" — leave the flight-recorder answer
+            # (lazy import: obs is off the breaker's hot path)
+            from ..obs import flightrec
+            flightrec.dump(f"circuit_open.{self.name}")
 
     def snapshot(self) -> dict:
         """State summary for /health."""

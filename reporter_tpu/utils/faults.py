@@ -132,7 +132,15 @@ class _FailPoint:
             self.fired += 1
         if self.kind == "crash":
             # uncatchable, no cleanup, no atexit — the closest a single
-            # process gets to SIGKILL while staying deterministic
+            # process gets to SIGKILL while staying deterministic. The
+            # flight recorder gets the last word first: its dump is the
+            # only evidence of what was in flight (guarded — a broken
+            # postmortem must not turn a crash test into a hang)
+            try:
+                from ..obs import flightrec
+                flightrec.dump(f"crash.{self.site}")
+            except BaseException:
+                pass
             sys.stderr.write(f"FAULT crash at {self.site}\n")
             sys.stderr.flush()
             os._exit(CRASH_EXIT_CODE)

@@ -1,4 +1,5 @@
-"""Lightweight metrics: counters, stage timers, optional device profiling.
+"""Lightweight metrics: counters, histogram stage timers, optional
+device profiling.
 
 The reference's only telemetry is a throughput counter logged every 10k
 messages (reference: KeyedFormattingProcessor.java:36-38,
@@ -8,13 +9,25 @@ tracing/profiling as an absent subsystem to build fresh.
 
 This module is that subsystem, kept deliberately small and lock-cheap:
 
-- ``Registry``: named monotonically-increasing counters and accumulating
-  timers (count / total seconds / max seconds), snapshot-able as a dict
-  for logs or a /stats endpoint.
-- ``timer(name)``: context manager recording a stage duration.
+- ``Registry``: named monotonically-increasing counters and stage
+  timers. A timer is a fixed log-bucketed histogram (power-of-2 bounds,
+  one numpy bucket increment per observation) plus count/total/max, so
+  ``snapshot()`` reports p50/p95/p99 per stage — count/total/max alone
+  cannot distinguish "steady 10 ms" from "9 ms with a 2 s tail", and
+  the tail is what pages people.
+- ``timer(name)``: context manager recording a stage duration. When
+  request tracing is armed (``obs.trace``) every timer site doubles as
+  a span site — the stage-timer discipline IS the span tree.
 - ``device_trace(out_dir)``: context manager wrapping
   ``jax.profiler.trace`` — a real TPU trace viewable in TensorBoard
-  or Perfetto — gated so importing this module never imports jax.
+  or Perfetto — gated so importing this module never imports jax. It
+  emits a correlation marker (``jax.profiler.TraceAnnotation`` carrying
+  the current trace id) so host spans line up with the XLA profile.
+
+Snapshots report RAW floats: the old 6-decimal rounding collapsed
+sub-microsecond timer means to 0.0, which read as "stage never ran".
+Rounding is the wire writer's job — ``/stats`` serialises through
+:func:`snapshot_rounded` (9 decimals, nanosecond resolution).
 
 All state lives in a process-global default registry (``metrics.default``)
 because every consumer in this framework is process-wide (one matcher, one
@@ -23,24 +36,77 @@ dispatcher); tests construct private ``Registry`` instances.
 from __future__ import annotations
 
 import contextlib
+import math
 import threading
 import time
-from typing import Dict, Iterator
+from typing import Dict, Iterator, List, Tuple
+
+import numpy as np
+
+from ..obs import trace as _trace
+
+#: histogram bucket upper bounds in seconds: powers of two from ~1 µs
+#: (2^-20) to 128 s (2^7). Log-spaced buckets keep relative error
+#: bounded (<= 2x anywhere) with a bucket index that is one frexp —
+#: no search — and 28 bounds cover every stage this framework times
+#: (sub-µs flag checks to multi-second cold compiles). One extra
+#: overflow bucket catches anything slower.
+_BUCKET_EXP_MIN = -20
+_BUCKET_EXP_MAX = 7
+BUCKET_BOUNDS_S: Tuple[float, ...] = tuple(
+    2.0 ** e for e in range(_BUCKET_EXP_MIN, _BUCKET_EXP_MAX + 1))
+_N_BUCKETS = len(BUCKET_BOUNDS_S) + 1  # + overflow
+
+
+def bucket_index(elapsed_s: float) -> int:
+    """Histogram bucket for a duration: ``frexp`` exponent, clipped.
+    A value in (2^(e-1), 2^e] lands in the bucket bounded by 2^e."""
+    if elapsed_s <= 0.0:
+        return 0
+    # frexp(x) = (m, e) with x = m * 2^e, m in [0.5, 1) — so e is the
+    # ceil of log2(x) for non-powers; exact powers land one higher,
+    # which still satisfies the le-bound contract (x <= 2^e)
+    e = math.frexp(elapsed_s)[1]
+    idx = e - _BUCKET_EXP_MIN
+    if idx < 0:
+        return 0
+    if idx >= _N_BUCKETS:
+        return _N_BUCKETS - 1
+    return idx
 
 
 class _Timer:
-    __slots__ = ("count", "total_s", "max_s")
+    __slots__ = ("count", "total_s", "max_s", "buckets")
 
     def __init__(self):
         self.count = 0
         self.total_s = 0.0
         self.max_s = 0.0
+        self.buckets = np.zeros(_N_BUCKETS, dtype=np.int64)
 
     def add(self, elapsed_s: float) -> None:
         self.count += 1
         self.total_s += elapsed_s
         if elapsed_s > self.max_s:
             self.max_s = elapsed_s
+        self.buckets[bucket_index(elapsed_s)] += 1
+
+    def quantile(self, q: float) -> float:
+        """Histogram quantile: find the bucket holding the q-th ranked
+        observation, interpolate linearly inside it, clamp to the
+        observed max (the last bucket is open-ended)."""
+        if self.count == 0:
+            return 0.0
+        target = q * self.count
+        cum = np.cumsum(self.buckets)
+        idx = int(np.searchsorted(cum, target, side="left"))
+        lo = BUCKET_BOUNDS_S[idx - 1] if idx > 0 else 0.0
+        hi = BUCKET_BOUNDS_S[idx] if idx < len(BUCKET_BOUNDS_S) \
+            else self.max_s
+        below = int(cum[idx - 1]) if idx > 0 else 0
+        in_bucket = int(self.buckets[idx])
+        frac = (target - below) / in_bucket if in_bucket else 1.0
+        return min(lo + frac * (hi - lo), self.max_s)
 
 
 class Registry:
@@ -58,9 +124,11 @@ class Registry:
 
     @contextlib.contextmanager
     def timer(self, name: str) -> Iterator[None]:
+        sp = _trace.span(name)  # no-op unless request tracing is armed
         t0 = time.perf_counter()
         try:
-            yield
+            with sp:
+                yield
         finally:
             elapsed = time.perf_counter() - t0
             with self._lock:
@@ -78,24 +146,61 @@ class Registry:
             t.add(elapsed_s)
 
     def snapshot(self) -> dict:
-        """{"counters": {...}, "timers": {name: {count,total_s,mean_s,max_s}}}"""
+        """{"counters": {...}, "timers": {name: {count, total_s, mean_s,
+        max_s, p50_s, p95_s, p99_s}}} — raw floats (see module doc)."""
         with self._lock:
             counters = dict(self._counters)
             timers = {
                 name: {
                     "count": t.count,
-                    "total_s": round(t.total_s, 6),
-                    "mean_s": round(t.total_s / t.count, 6) if t.count else 0.0,
-                    "max_s": round(t.max_s, 6),
+                    "total_s": t.total_s,
+                    "mean_s": t.total_s / t.count if t.count else 0.0,
+                    "max_s": t.max_s,
+                    "p50_s": t.quantile(0.50),
+                    "p95_s": t.quantile(0.95),
+                    "p99_s": t.quantile(0.99),
                 }
                 for name, t in self._timers.items()
             }
         return {"counters": counters, "timers": timers}
 
+    def export_state(self) -> Tuple[Dict[str, int],
+                                    Dict[str, Tuple[int, float, float,
+                                                    List[int]]]]:
+        """One atomic copy for exposition writers: (counters,
+        {timer: (count, total_s, max_s, bucket counts)}). Bucket counts
+        align with ``BUCKET_BOUNDS_S`` plus one trailing overflow."""
+        with self._lock:
+            counters = dict(self._counters)
+            timers = {name: (t.count, t.total_s, t.max_s,
+                             t.buckets.tolist())
+                      for name, t in self._timers.items()}
+        return counters, timers
+
     def reset(self) -> None:
         with self._lock:
             self._counters.clear()
             self._timers.clear()
+
+    def reset_timers(self) -> None:
+        """Clear timers only: bench legs isolate one stage's histogram
+        without zeroing cache-hit/egress counters mid-run."""
+        with self._lock:
+            self._timers.clear()
+
+
+def snapshot_rounded(registry: "Registry | None" = None,
+                     ndigits: int = 9) -> dict:
+    """The /stats wire form: :meth:`Registry.snapshot` with timer floats
+    rounded for the JSON body. 9 decimals = nanosecond resolution, so
+    sub-microsecond stages stay visible (the old 6-decimal rounding
+    inside snapshot() flattened them to 0.0)."""
+    snap = (registry if registry is not None else default).snapshot()
+    snap["timers"] = {
+        name: {k: round(v, ndigits) if isinstance(v, float) else v
+               for k, v in t.items()}
+        for name, t in snap["timers"].items()}
+    return snap
 
 
 #: process-global registry used by the service/worker/pipeline
@@ -110,11 +215,20 @@ snapshot = default.snapshot
 def device_trace(out_dir: str) -> Iterator[None]:
     """Capture an XLA/TPU profiler trace into ``out_dir`` (view with
     TensorBoard's profile plugin or Perfetto). A no-op context if jax is
-    unavailable."""
+    unavailable. When request tracing is armed, the profiled region is
+    wrapped in a ``TraceAnnotation`` naming the current trace id — the
+    correlation marker that lines host spans up with the XLA timeline."""
     try:
         import jax
     except ImportError:  # pragma: no cover - jax is baked into this image
         yield
         return
-    with jax.profiler.trace(out_dir):
-        yield
+    with _trace.span("device_trace", out_dir=out_dir):
+        ctx = _trace.current()
+        with jax.profiler.trace(out_dir):
+            if ctx is not None:
+                with jax.profiler.TraceAnnotation(
+                        f"reporter_tpu.trace:{ctx[0]}"):
+                    yield
+            else:
+                yield

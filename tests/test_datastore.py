@@ -210,6 +210,29 @@ class TestIngestDir:
         files = list(scan_tiles(str(tmp_path)))
         assert len(files) == 1 and files[0].endswith("rtpu.abc123")
 
+    def test_scan_skips_flightrec_dumps(self, tmp_path):
+        """The flight recorder's postmortems share the spool layout —
+        an ingest replay must never mistake span JSON for tile CSV
+        (same contract as .traces/.deadletter)."""
+        self._flush_layout(str(tmp_path), _segs(2))
+        rec = os.path.join(str(tmp_path), ".flightrec")
+        os.makedirs(rec)
+        with open(os.path.join(rec, "flightrec-1-0001-crash.json"),
+                  "w") as f:
+            f.write('{"reason":"crash.worker.offer","spans":[]}')
+        files = list(scan_tiles(str(tmp_path)))
+        assert len(files) == 1 and files[0].endswith("rtpu.abc123")
+        # and the same holds scanning a dead-letter spool that carries
+        # a nested .flightrec (the default dump location)
+        dl = tmp_path / "dl"
+        self._flush_layout(str(dl), _segs(2), name="rtpu.spooled")
+        os.makedirs(str(dl / ".flightrec"))
+        with open(str(dl / ".flightrec" / "flightrec-1-0002-x.json"),
+                  "w") as f:
+            f.write("{}")
+        files = list(scan_tiles(str(dl)))
+        assert len(files) == 1 and files[0].endswith("rtpu.spooled")
+
     def test_ingest_dir_and_delete(self, tmp_path):
         out_dir = tmp_path / "results"
         self._flush_layout(str(out_dir), _segs(5))

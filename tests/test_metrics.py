@@ -3,7 +3,9 @@ SURVEY.md §5 lists as absent in the reference and built fresh here)."""
 import threading
 import time
 
-from reporter_tpu.utils.metrics import Registry, device_trace
+from reporter_tpu.utils.metrics import (BUCKET_BOUNDS_S, Registry,
+                                        bucket_index, device_trace,
+                                        snapshot_rounded)
 
 
 class TestRegistry:
@@ -60,6 +62,72 @@ class TestRegistry:
         r.observe("t", 1.0)
         r.reset()
         assert r.snapshot() == {"counters": {}, "timers": {}}
+
+    def test_reset_timers_keeps_counters(self):
+        """Bench legs isolate one stage's histogram without dropping
+        cache-hit/egress counters accumulated across legs."""
+        r = Registry()
+        r.count("egress.ok", 7)
+        r.observe("stage", 0.5)
+        r.reset_timers()
+        snap = r.snapshot()
+        assert snap["timers"] == {}
+        assert snap["counters"] == {"egress.ok": 7}
+
+
+class TestHistogramTimers:
+    def test_sub_microsecond_mean_not_collapsed(self):
+        """The old snapshot() rounded to 6 decimals, flattening sub-µs
+        timers to 0.0 — raw floats now, rounding is the wire's job."""
+        r = Registry()
+        for _ in range(4):
+            r.observe("tiny", 5e-7)
+        t = r.snapshot()["timers"]["tiny"]
+        assert t["mean_s"] == 5e-7
+        assert t["total_s"] == 2e-6
+        # the /stats writer rounds at nanosecond resolution: still visible
+        rounded = snapshot_rounded(r)["timers"]["tiny"]
+        assert rounded["mean_s"] == 5e-7
+
+    def test_bucket_index_log2(self):
+        assert bucket_index(0.0) == 0
+        assert bucket_index(1e-12) == 0  # below the smallest bound
+        # a value lands in a bucket whose bound is >= the value
+        for v in (3e-6, 0.004, 0.7, 10.0):
+            idx = bucket_index(v)
+            assert BUCKET_BOUNDS_S[idx] >= v
+            if idx > 0:
+                assert BUCKET_BOUNDS_S[idx - 1] <= v * 2
+        # past the largest bound: the overflow bucket
+        assert bucket_index(1e6) == len(BUCKET_BOUNDS_S)
+
+    def test_percentiles_ordered_and_bounded(self):
+        r = Registry()
+        for ms in (1, 2, 3, 4, 5, 6, 7, 8, 9, 200):
+            r.observe("stage", ms / 1000.0)
+        t = r.snapshot()["timers"]["stage"]
+        assert 0.0 < t["p50_s"] <= t["p95_s"] <= t["p99_s"] <= t["max_s"]
+        # the one 200 ms outlier must pull p99 well above p50: this is
+        # exactly the tail count/total/max could not see
+        assert t["p99_s"] > 0.05
+        assert t["p50_s"] < 0.02
+
+    def test_percentiles_single_observation(self):
+        r = Registry()
+        r.observe("once", 0.01)
+        t = r.snapshot()["timers"]["once"]
+        assert t["p50_s"] == t["p99_s"] == t["max_s"] == 0.01
+
+    def test_export_state_buckets_sum_to_count(self):
+        r = Registry()
+        for v in (1e-7, 1e-3, 0.3, 50.0, 1e4):
+            r.observe("s", v)
+        _counters, timers = r.export_state()
+        count, total, max_s, buckets = timers["s"]
+        assert count == 5 and sum(buckets) == 5
+        assert max_s == 1e4 and abs(total - 10050.3011) < 1e-3
+        # one overflow landed past the largest bound
+        assert buckets[-1] == 1
 
 
 class TestDeviceTrace:

@@ -1,0 +1,520 @@
+"""Observability layer: spans, trace-event export, flight recorder,
+Prometheus exposition, SLO checks, heartbeat — ISSUE 7."""
+import json
+import logging
+import os
+import re
+import socket
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+
+import numpy as np
+import pytest
+
+from reporter_tpu.analysis import registry as contract_registry
+from reporter_tpu.matcher import SegmentMatcher
+from reporter_tpu.obs import flightrec, prom, slo
+from reporter_tpu.obs import trace as obs_trace
+from reporter_tpu.service.server import ReporterService, serve
+from reporter_tpu.synth import build_grid_city, generate_trace
+from reporter_tpu.utils import metrics
+from reporter_tpu.utils.metrics import BUCKET_BOUNDS_S, Registry
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs():
+    """Every test starts and ends disarmed with an empty ring."""
+    obs_trace.configure(False)
+    flightrec.reset()
+    yield
+    obs_trace.configure(False)
+    flightrec.reset()
+
+
+# ---------------------------------------------------------------------------
+class TestSpans:
+    def test_disarmed_is_shared_noop(self):
+        # one flag check, zero allocation: the same object every time
+        assert obs_trace.span("a") is obs_trace.span("b")
+        assert obs_trace.current() is None
+        with obs_trace.span("a"):
+            pass
+        assert flightrec.events() == []
+
+    def test_nesting_and_parent_ids(self):
+        obs_trace.configure(True)
+        with obs_trace.span("root") as root:
+            with obs_trace.span("child") as child:
+                with obs_trace.span("grandchild") as gc:
+                    pass
+        evs = {e["name"]: e for e in flightrec.events()}
+        assert evs["root"]["parent_id"] == 0
+        assert evs["child"]["parent_id"] == root.span_id
+        assert evs["grandchild"]["parent_id"] == child.span_id
+        assert evs["root"]["trace_id"] == evs["child"]["trace_id"] \
+            == evs["grandchild"]["trace_id"] == root.trace_id
+        assert gc.trace_id == root.trace_id
+        # children close before parents, durations nest
+        assert evs["root"]["dur_ns"] >= evs["child"]["dur_ns"] \
+            >= evs["grandchild"]["dur_ns"]
+
+    def test_sibling_spans_share_parent(self):
+        obs_trace.configure(True)
+        with obs_trace.span("root") as root:
+            with obs_trace.span("a"):
+                pass
+            with obs_trace.span("b"):
+                pass
+        evs = {e["name"]: e for e in flightrec.events()}
+        assert evs["a"]["parent_id"] == root.span_id
+        assert evs["b"]["parent_id"] == root.span_id
+
+    def test_force_begin_end_arms_per_request(self):
+        assert not obs_trace.enabled()
+        obs_trace.force_begin()
+        try:
+            assert obs_trace.enabled()
+            with obs_trace.span("forced"):
+                pass
+        finally:
+            obs_trace.force_end()
+        assert not obs_trace.enabled()
+        assert [e["name"] for e in flightrec.events()] == ["forced"]
+
+    def test_attach_carries_context_across_threads(self):
+        import threading
+        obs_trace.configure(True)
+        seen = {}
+
+        def worker(ctx):
+            with obs_trace.attach(ctx):
+                with obs_trace.span("lane") as sp:
+                    seen["trace_id"] = sp.trace_id
+                    seen["parent_id"] = sp.parent_id
+
+        with obs_trace.span("root") as root:
+            ctx = obs_trace.current()
+            t = threading.Thread(target=worker, args=(ctx,))
+            t.start()
+            t.join()
+        assert seen["trace_id"] == root.trace_id
+        assert seen["parent_id"] == root.span_id
+
+    def test_metrics_timer_doubles_as_span(self):
+        obs_trace.configure(True)
+        r = Registry()
+        with obs_trace.span("root") as root:
+            with r.timer("stage.x"):
+                pass
+        names = [e["name"] for e in flightrec.events()]
+        assert "stage.x" in names
+        ev = next(e for e in flightrec.events() if e["name"] == "stage.x")
+        assert ev["parent_id"] == root.span_id
+        # and the timer still recorded
+        assert r.snapshot()["timers"]["stage.x"]["count"] == 1
+
+    def test_phase_spans_reconstruct_backwards_from_now(self):
+        obs_trace.configure(True)
+        with obs_trace.span("prep") as prep:
+            obs_trace.phase_spans(("c", "s", "r"), [1000, 0, 3000])
+        evs = {e["name"]: e for e in flightrec.events() if e["name"] != "prep"}
+        assert set(evs) == {"c", "r"}  # zero-ns phases dropped
+        assert evs["c"]["parent_id"] == prep.span_id
+        assert evs["c"]["dur_ns"] == 1000 and evs["r"]["dur_ns"] == 3000
+        # back-to-back: c ends where r begins
+        assert evs["c"]["t0_ns"] + evs["c"]["dur_ns"] == evs["r"]["t0_ns"]
+        assert evs["c"]["attrs"]["synthetic"] is True
+
+
+class TestTraceEvents:
+    def test_export_shape(self):
+        obs_trace.configure(True)
+        with obs_trace.span("root", kind="t") as root:
+            with obs_trace.span("child"):
+                pass
+        obj = obs_trace.export_trace(root)
+        assert obj["displayTimeUnit"] == "ms"
+        evs = obj["traceEvents"]
+        assert {e["name"] for e in evs} == {"root", "child"}
+        for e in evs:
+            assert e["ph"] == "X"
+            assert isinstance(e["ts"], float) and isinstance(e["dur"], float)
+            assert e["pid"] == os.getpid()
+            assert e["args"]["trace_id"] == root.trace_id
+        rootev = next(e for e in evs if e["name"] == "root")
+        assert rootev["args"]["kind"] == "t"
+        json.dumps(obj)  # serialisable as-is
+
+    def test_export_filters_by_trace_id(self):
+        obs_trace.configure(True)
+        with obs_trace.span("one") as first:
+            pass
+        with obs_trace.span("two"):
+            pass
+        obj = obs_trace.export_trace(first)
+        assert [e["name"] for e in obj["traceEvents"]] == ["one"]
+
+    def test_export_of_noop_is_empty(self):
+        root = obs_trace.span("never-armed")  # disarmed: the noop
+        assert obs_trace.export_trace(root) == {
+            "traceEvents": [], "displayTimeUnit": "ms"}
+
+    def test_in_flight_rendered_as_begin_events(self):
+        obs_trace.configure(True)
+        sp = obs_trace.span("open")
+        sp.__enter__()
+        try:
+            obj = obs_trace.to_trace_events([], flightrec.in_flight())
+            assert obj["traceEvents"][0]["ph"] == "B"
+            assert obj["traceEvents"][0]["args"]["in_flight"] is True
+        finally:
+            sp.__exit__(None, None, None)
+
+
+# ---------------------------------------------------------------------------
+class TestFlightRecorder:
+    def test_ring_is_bounded(self):
+        obs_trace.configure(True)
+        for i in range(flightrec.RING_EVENTS + 50):
+            with obs_trace.span("s"):
+                pass
+        assert len(flightrec.events()) == flightrec.RING_EVENTS
+
+    def test_dump_names_in_flight_span(self, tmp_path, monkeypatch):
+        monkeypatch.setattr(flightrec, "_dump_dir", str(tmp_path))
+        obs_trace.configure(True)
+        with obs_trace.span("done"):
+            pass
+        sp = obs_trace.span("inflight")
+        sp.__enter__()
+        try:
+            path = flightrec.dump("test.reason", {"k": 1})
+        finally:
+            sp.__exit__(None, None, None)
+        assert path and os.path.exists(path)
+        with open(path, encoding="utf-8") as f:
+            post = json.load(f)
+        assert post["reason"] == "test.reason"
+        assert post["extra"] == {"k": 1}
+        assert [s["name"] for s in post["in_flight"]] == ["inflight"]
+        assert post["in_flight"][0]["age_ns"] >= 0
+        assert [s["name"] for s in post["spans"]] == ["done"]
+        assert "counters" in post
+        # the postmortem is itself counted
+        assert metrics.default.snapshot()["counters"]["flightrec.dumps"] >= 1
+
+    def test_dump_without_dir_is_skipped(self, monkeypatch):
+        monkeypatch.setattr(flightrec, "_dump_dir", None)
+        assert flightrec.dump("nowhere") is None
+
+    def test_env_dir_wins_over_derived(self, tmp_path, monkeypatch):
+        monkeypatch.setattr(flightrec, "_dump_dir", str(tmp_path / "env"))
+        monkeypatch.setattr(flightrec, "_dir_from_env", True)
+        flightrec.set_dump_dir(str(tmp_path / "derived"))
+        assert flightrec.dump_dir() == str(tmp_path / "env")
+
+    def test_worker_exception_leaves_postmortem(self, tmp_path,
+                                                monkeypatch):
+        from reporter_tpu.streaming.worker import StreamWorker
+        from reporter_tpu.streaming.anonymiser import Anonymiser, TileSink
+        from reporter_tpu.streaming.formatter import Formatter
+        monkeypatch.setattr(flightrec, "_dir_from_env", False)
+        obs_trace.configure(True)
+
+        def boom(_trace):
+            raise RuntimeError("matcher exploded")
+
+        worker = StreamWorker(
+            Formatter.from_config(r",sv,\|,0,1,2,3,4"), boom,
+            Anonymiser(TileSink(str(tmp_path / "out")), 1, 3600))
+        # the worker derived its dump dir from the dead-letter spool
+        rec_dir = os.path.join(str(tmp_path / "out"), ".deadletter",
+                               ".flightrec")
+        assert flightrec.dump_dir() == rec_dir
+        monkeypatch.setattr(worker, "offer",
+                            lambda _m: (_ for _ in ()).throw(
+                                RuntimeError("stream died")))
+        with pytest.raises(RuntimeError):
+            worker.run(iter(["x|1|2|3|4"]))
+        dumps = os.listdir(rec_dir)
+        assert len(dumps) == 1 and "worker.exception" in dumps[0]
+
+
+# ---------------------------------------------------------------------------
+class TestSLO:
+    def test_parse_spec(self):
+        assert slo.parse_spec("a.b=250,c=1.5") == {"a.b": 0.25,
+                                                   "c": 0.0015}
+        assert slo.parse_spec("") == {}
+        for bad in ("a", "a=", "a=x", "a=-5", "a=0"):
+            with pytest.raises(ValueError):
+                slo.parse_spec(bad)
+
+    def test_breach_on_p99(self, monkeypatch):
+        r = Registry()
+        for _ in range(20):
+            r.observe("stage", 0.004)
+        monkeypatch.setenv(slo.ENV_VAR, "stage=100")
+        out = slo.check(r)
+        assert out["breaches"] == []
+        monkeypatch.setenv(slo.ENV_VAR, "stage=1")
+        out = slo.check(r)
+        assert len(out["breaches"]) == 1
+        b = out["breaches"][0]
+        assert b["stage"] == "stage" and b["p99_s"] > 0.001
+        # an idle stage never breaches
+        monkeypatch.setenv(slo.ENV_VAR, "stage=1,never_ran=1")
+        assert len(slo.check(r)["breaches"]) == 1
+
+    def test_malformed_spec_fails_open(self, monkeypatch):
+        monkeypatch.setenv(slo.ENV_VAR, "garbage")
+        assert slo.check(Registry()) == {"targets": {}, "breaches": []}
+
+
+# ---------------------------------------------------------------------------
+_SAMPLE_RE = re.compile(
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{le="[^"]+"\})? [0-9eE.+-]+$')
+_META_RE = re.compile(r"^# TYPE [a-zA-Z_:][a-zA-Z0-9_:]* (counter|histogram)$")
+
+
+def _assert_scrape_clean(text):
+    """A Prometheus text-format parser in miniature: every line must be
+    a TYPE comment or a sample, histogram buckets must be cumulative,
+    and +Inf must equal _count."""
+    buckets = {}
+    counts = {}
+    assert text.endswith("\n")
+    for line in text.strip("\n").split("\n"):
+        assert _META_RE.match(line) or _SAMPLE_RE.match(line), line
+        if line.startswith("#"):
+            continue
+        name = line.split("{")[0].split(" ")[0]
+        value = float(line.rsplit(" ", 1)[1])
+        assert value >= 0, line
+        if name.endswith("_bucket"):
+            fam = buckets.setdefault(name, [])
+            assert not fam or value >= fam[-1], f"non-monotone: {line}"
+            fam.append(value)
+        elif name.endswith("_count"):
+            counts[name[:-len("_count")]] = value
+    for fam, vals in buckets.items():
+        base = fam[:-len("_bucket")]
+        assert vals[-1] == counts[base], fam
+
+
+class TestPromExposition:
+    def _golden_registry(self):
+        r = Registry()
+        r.count("service.requests", 3)
+        r.count("egress.ok")
+        r.observe("service.handle", 0.001)
+        r.observe("service.handle", 0.002)
+        r.observe("service.handle", 0.5)
+        return r
+
+    def test_golden_format(self):
+        """Pin the exposition bytes: a dashboard built on this format
+        must not drift (regenerate the fixture deliberately if the
+        format changes)."""
+        fixture = os.path.join(os.path.dirname(__file__), "fixtures",
+                               "metrics_exposition.txt")
+        with open(fixture, encoding="utf-8") as f:
+            want = f.read()
+        assert prom.render(self._golden_registry()) == want
+
+    def test_golden_is_scrape_clean(self):
+        _assert_scrape_clean(prom.render(self._golden_registry()))
+
+    def test_bucket_monotone_and_inf_equals_count(self):
+        r = Registry()
+        for v in (1e-8, 1e-4, 0.1, 3.0, 1e5):  # incl. an overflow
+            r.observe("s", v)
+        text = prom.render(r)
+        _assert_scrape_clean(text)
+        assert f'reporter_tpu_s_seconds_bucket{{le="+Inf"}} 5' in text
+        assert "reporter_tpu_s_seconds_count 5" in text
+
+    def test_every_registered_metric_renders(self):
+        """Every exact entry in the contract registry's METRICS table,
+        fed through the metrics layer as a counter AND a timer, renders
+        as valid exposition without name mangling — so no registered
+        name can produce an unscrapable /metrics."""
+        r = Registry()
+        exact = [name for name in contract_registry.METRICS
+                 if not name.endswith("*")]
+        assert exact, "contract registry lost its METRICS entries"
+        for name in exact:
+            r.count(name)
+            r.observe(name, 0.001)
+        text = prom.render(r)
+        _assert_scrape_clean(text)
+        for name in exact:
+            base = prom.PREFIX + "_" + prom.sanitize(name)
+            assert f"{base}_total 1" in text, name
+            assert f"{base}_seconds_count 1" in text, name
+
+    def test_prefix_pattern_families_render(self):
+        """Dynamic families (the registry's `prefix.*` patterns) render
+        too — instantiate each pattern with a representative suffix."""
+        r = Registry()
+        patterns = [name for name in contract_registry.METRICS
+                    if name.endswith("*")]
+        assert patterns
+        for pat in patterns:
+            r.count(pat[:-1] + "x")
+        _assert_scrape_clean(prom.render(r))
+
+
+# ---------------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def city():
+    return build_grid_city(rows=10, cols=10, spacing_m=200.0, seed=3,
+                           service_road_fraction=0.0,
+                           internal_fraction=0.0)
+
+
+@pytest.fixture(scope="module")
+def server(city):
+    service = ReporterService(SegmentMatcher(net=city), threshold_sec=15,
+                              max_batch=64, max_wait_ms=10.0)
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    httpd = serve(service, "127.0.0.1", port)
+    yield f"http://127.0.0.1:{port}"
+    httpd.shutdown()
+
+
+def make_req(city, seed):
+    rng = np.random.default_rng(seed)
+    tr = None
+    while tr is None:
+        tr = generate_trace(city, f"obs-{seed}", rng, noise_m=3.0)
+    return tr.request_json()
+
+
+def post(url, body):
+    req = urllib.request.Request(url, data=json.dumps(body).encode(),
+                                 method="POST")
+    try:
+        with urllib.request.urlopen(req) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+class TestServiceObservability:
+    def test_metrics_endpoint_scrape_clean(self, city, server):
+        post(f"{server}/report", make_req(city, 1))
+        with urllib.request.urlopen(f"{server}/metrics") as r:
+            assert r.status == 200
+            assert r.headers["Content-type"].startswith("text/plain")
+            text = r.read().decode()
+        _assert_scrape_clean(text)
+        assert "reporter_tpu_service_requests_total" in text
+        assert "reporter_tpu_service_handle_seconds_bucket" in text
+
+    def test_stats_reports_percentiles(self, city, server):
+        post(f"{server}/report", make_req(city, 2))
+        with urllib.request.urlopen(f"{server}/stats") as r:
+            stats = json.loads(r.read())
+        t = stats["timers"]["service.handle"]
+        assert t["p50_s"] <= t["p95_s"] <= t["p99_s"] <= t["max_s"]
+
+    def test_trace_flag_ships_span_tree(self, city, server):
+        req = make_req(city, 4)
+        code, plain = post(f"{server}/report", req)  # warm + compare
+        assert code == 200
+        code, body = post(f"{server}/report?trace=1", req)
+        assert code == 200
+        assert set(body) == {"report", "trace"}
+        # the report payload is the normal response, unchanged
+        assert body["report"]["stats"] == plain["stats"]
+        evs = body["trace"]["traceEvents"]
+        names = {e["name"] for e in evs}
+        for need in ("service.request", "service.parse", "service.handle",
+                     "dispatch.batch", "dispatch.match_many",
+                     "matcher.chunk", "report.serialise"):
+            assert need in names, (need, sorted(names))
+        root = next(e for e in evs if e["name"] == "service.request")
+        # every event belongs to this one request's trace
+        assert {e["args"]["trace_id"] for e in evs} \
+            == {root["args"]["trace_id"]}
+        # tracing disarms once the request is done
+        assert not obs_trace.enabled()
+
+    def test_untraced_requests_record_no_spans(self, city, server):
+        flightrec.reset()
+        code, _ = post(f"{server}/report", make_req(city, 5))
+        assert code == 200
+        assert flightrec.events() == []
+
+    def test_trace_flag_falsy_spellings_stay_plain(self, city, server):
+        """?trace=false / ?trace=off must NOT arm tracing or change the
+        response shape (same falsy set as the env flag)."""
+        for spelling in ("false", "off", "0"):
+            code, body = post(f"{server}/report?trace={spelling}",
+                              make_req(city, 7))
+            assert code == 200
+            assert "stats" in body and "trace" not in body, spelling
+
+    def test_health_slo_breach_degrades(self, city, server, monkeypatch):
+        post(f"{server}/report", make_req(city, 6))
+        monkeypatch.setenv(slo.ENV_VAR, "service.handle=0.000001")
+        code, body = post_health(server)
+        assert code == 503
+        assert body["status"] == "degraded"
+        assert body["slo"]["breaches"][0]["stage"] == "service.handle"
+        monkeypatch.delenv(slo.ENV_VAR)
+        code, body = post_health(server)
+        assert code == 200 and body["slo"]["breaches"] == []
+
+
+def post_health(server):
+    try:
+        with urllib.request.urlopen(f"{server}/health") as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+# ---------------------------------------------------------------------------
+class TestHeartbeat:
+    def test_heartbeat_line_is_json(self, tmp_path, monkeypatch, caplog):
+        from reporter_tpu.streaming.anonymiser import Anonymiser, TileSink
+        from reporter_tpu.streaming.formatter import Formatter
+        from reporter_tpu.streaming.worker import StreamWorker
+        monkeypatch.setenv("REPORTER_TPU_HEARTBEAT_S", "0.0001")
+
+        def submit(_trace):
+            return None
+
+        worker = StreamWorker(
+            Formatter.from_config(r",sv,\|,0,1,2,3,4"), submit,
+            Anonymiser(TileSink(str(tmp_path / "out")), 1, 3600),
+            circuit_probe=lambda: "closed")
+        assert worker.heartbeat_s == 0.0001
+        with caplog.at_level(logging.INFO, "reporter_tpu.streaming"):
+            time.sleep(0.001)
+            worker.offer("hb-uuid|45.0|-122.0|1000|5")
+        lines = [rec.message for rec in caplog.records
+                 if rec.message.startswith("heartbeat ")]
+        assert lines, "no heartbeat emitted"
+        payload = json.loads(lines[0][len("heartbeat "):])
+        assert payload["processed"] == 1
+        assert payload["batches_in_flight"] == 1
+        assert payload["flush_epoch"] == 0
+        assert payload["circuit"] == "closed"
+        assert payload["msgs_per_s"] >= 0
+
+    def test_heartbeat_default_off(self, tmp_path, monkeypatch):
+        from reporter_tpu.streaming.anonymiser import Anonymiser, TileSink
+        from reporter_tpu.streaming.formatter import Formatter
+        from reporter_tpu.streaming.worker import StreamWorker
+        monkeypatch.delenv("REPORTER_TPU_HEARTBEAT_S", raising=False)
+        worker = StreamWorker(
+            Formatter.from_config(r",sv,\|,0,1,2,3,4"), lambda t: None,
+            Anonymiser(TileSink(str(tmp_path / "out")), 1, 3600))
+        assert worker.heartbeat_s == 0.0

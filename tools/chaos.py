@@ -105,7 +105,8 @@ def _tile_tree(root: str) -> dict:
     out = {}
     for dirpath, dirnames, filenames in os.walk(root):
         dirnames[:] = sorted(d for d in dirnames
-                             if d not in (".deadletter", ".traces"))
+                             if d not in (".deadletter", ".traces",
+                                          ".flightrec"))
         for name in sorted(filenames):
             path = os.path.join(dirpath, name)
             with open(path, "rb") as f:
@@ -250,7 +251,10 @@ def scenario_kill_restore() -> int:
         out_chaos = os.path.join(tmp, "chaos")
         state = os.path.join(tmp, "s_chaos")
         log(f"kill_restore: crashing at offer {k + 1}")
-        env_crash = dict(env,
+        # tracing armed on the crash leg only: the flight recorder's
+        # postmortem must name the exact span in flight at SIGKILL
+        # (tile bytes are unaffected — spans never touch the sink)
+        env_crash = dict(env, REPORTER_TPU_TRACE="1",
                          REPORTER_TPU_FAULTS=f"worker.offer=crash+{k}#1")
         p = subprocess.run(cmd(full, out_chaos, state), env=env_crash,
                            cwd=REPO, capture_output=True, text=True,
@@ -261,6 +265,20 @@ def scenario_kill_restore() -> int:
                         f"{p.stderr[-2000:]}")
         if not os.path.exists(state):
             return fail("no state snapshot survived the crash")
+        rec_dir = os.path.join(out_chaos, ".deadletter", ".flightrec")
+        dumps = sorted(os.listdir(rec_dir)) if os.path.isdir(rec_dir) \
+            else []
+        if not dumps:
+            return fail(f"crash left no flight-recorder dump in {rec_dir}")
+        with open(os.path.join(rec_dir, dumps[-1]), encoding="utf-8") as f:
+            post = json.load(f)
+        inflight = [s["name"] for s in post.get("in_flight", [])]
+        if not post["reason"].startswith("crash.worker.offer") \
+                or "worker.offer" not in inflight:
+            return fail(f"postmortem does not name the SIGKILL'd span: "
+                        f"reason={post['reason']!r} in_flight={inflight}")
+        log(f"kill_restore: postmortem {dumps[-1]} names in-flight "
+            f"span worker.offer")
 
         log("kill_restore: restarting from the snapshot")
         p = subprocess.run(cmd(tail, out_chaos, state), env=env, cwd=REPO,

@@ -1,0 +1,141 @@
+#!/usr/bin/env python
+"""trace_cli: Chrome/Perfetto trace-event export for reporter traces.
+
+Two subcommands:
+
+  convert   Turn recorded span JSON into a trace-event file that
+            chrome://tracing or https://ui.perfetto.dev loads directly.
+            Accepts any of the three shapes this framework emits:
+              - a flight-recorder postmortem dump
+                (``.flightrec/flightrec-<pid>-*.json``: spans + in_flight)
+              - a ``?trace=1`` /report response ({"report":..., "trace":...})
+              - a bare span-record list or a {"traceEvents": [...]} object
+                (already-exported traces pass through unchanged)
+
+  record    Run one synthetic /report request through the real stack
+            with tracing armed and write its trace-event JSON — the
+            zero-setup way to SEE the pipeline (service -> dispatcher ->
+            matcher prep/decode/assemble -> serialisation) on a timeline.
+
+Usage:
+  python tools/trace_cli.py convert <in.json> [-o out.json]
+  python tools/trace_cli.py record [-o out.json] [--traces N]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("REPORTER_TPU_PLATFORM", "cpu")
+
+
+def spans_from_payload(payload):
+    """(closed spans, in-flight spans) from any recognised JSON shape;
+    already-exported traceEvents come back as (None, events)."""
+    if isinstance(payload, list):
+        return payload, []
+    if not isinstance(payload, dict):
+        raise ValueError("unrecognised trace payload (want a JSON "
+                         "object or span list)")
+    if "traceEvents" in payload:
+        return None, payload["traceEvents"]
+    if "trace" in payload and isinstance(payload["trace"], dict) \
+            and "traceEvents" in payload["trace"]:
+        return None, payload["trace"]["traceEvents"]
+    if "spans" in payload:  # flight-recorder dump
+        return payload.get("spans", []), payload.get("in_flight", [])
+    raise ValueError("unrecognised trace payload (no spans / "
+                     "traceEvents / trace key)")
+
+
+def cmd_convert(args) -> int:
+    from reporter_tpu.obs import trace as obs_trace
+
+    with open(args.input, encoding="utf-8") as f:
+        payload = json.load(f)
+    spans, extra = spans_from_payload(payload)
+    if spans is None:
+        obj = {"traceEvents": extra, "displayTimeUnit": "ms"}
+    else:
+        obj = obs_trace.to_trace_events(spans, in_flight=extra)
+    out = args.output or (os.path.splitext(args.input)[0] + ".trace.json")
+    with open(out, "w", encoding="utf-8") as f:
+        json.dump(obj, f, separators=(",", ":"))
+    print(f"{len(obj['traceEvents'])} events -> {out} "
+          "(load in chrome://tracing or ui.perfetto.dev)")
+    return 0
+
+
+def cmd_record(args) -> int:
+    from reporter_tpu.utils.runtime import force_virtual_cpu
+    force_virtual_cpu()
+
+    import numpy as np
+
+    from reporter_tpu.matcher import SegmentMatcher
+    from reporter_tpu.obs import trace as obs_trace
+    from reporter_tpu.service.server import ReporterService
+    from reporter_tpu.synth import build_grid_city, generate_trace
+
+    city = build_grid_city(rows=8, cols=8, spacing_m=200.0, seed=5,
+                           service_road_fraction=0.0,
+                           internal_fraction=0.0)
+    rng = np.random.default_rng(7)
+    reqs = []
+    for i in range(args.traces):
+        tr = None
+        while tr is None:
+            tr = generate_trace(city, f"trace-cli-{i}", rng, noise_m=3.0,
+                                min_route_edges=8)
+        reqs.append({"uuid": tr.uuid, "trace": tr.points,
+                     "match_options": {"mode": "auto",
+                                       "report_levels": [0, 1, 2],
+                                       "transition_levels": [0, 1, 2]}})
+    service = ReporterService(SegmentMatcher(net=city), threshold_sec=15,
+                              max_batch=64, max_wait_ms=5.0)
+    service.handle(reqs[0])  # warm the jit caches outside the recording
+
+    obs_trace.force_begin()
+    try:
+        with obs_trace.span("service.request", source="trace_cli") as root:
+            for req in reqs:
+                code, _body = service.handle(req)
+                if code != 200:
+                    print(f"request failed ({code})", file=sys.stderr)
+                    return 1
+        obj = obs_trace.export_trace(root)
+    finally:
+        obs_trace.force_end()
+    out = args.output or "reporter_trace.json"
+    with open(out, "w", encoding="utf-8") as f:
+        json.dump(obj, f, separators=(",", ":"))
+    print(f"{len(obj['traceEvents'])} events over {args.traces} "
+          f"request(s) -> {out} (load in chrome://tracing or "
+          "ui.perfetto.dev)")
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="trace_cli", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    p_conv = sub.add_parser("convert", help="span JSON -> trace events")
+    p_conv.add_argument("input")
+    p_conv.add_argument("-o", "--output")
+    p_rec = sub.add_parser("record", help="record one traced request")
+    p_rec.add_argument("-o", "--output")
+    p_rec.add_argument("--traces", type=int, default=1)
+    args = parser.parse_args(argv)
+    if args.cmd == "convert":
+        return cmd_convert(args)
+    return cmd_record(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
