@@ -315,6 +315,23 @@ def main():
                                      repeats)
     batched_tps = n_traces / best
 
+    # device-compute telemetry of the whole run (obs/profiler.py): a
+    # steady-state bench should compile each decode shape exactly once
+    # (in warmup) — recompiles here mean the timed legs paid XLA, and
+    # padding_waste is the fixed-bucket overhead the artifact now
+    # carries toward the variable-length bucketing work
+    from reporter_tpu.obs import profiler
+    prof = profiler.snapshot(n_events=0)
+    compile_field = {
+        "episodes": prof["compile_episodes"],
+        "shapes": len(prof["shapes"]),
+        "recompiles": sum(max(0, s["compiles"] - 1)
+                          for s in prof["shapes"]),
+        "compile_s": round(sum(s["compile_s"] for s in prof["shapes"]),
+                           6),
+        "padding_waste": prof["totals"]["padding_waste"],
+    }
+
     # -- optional second decode backend: the fused pallas kernel ----------
     # recorded in the same artifact so hardware claims in docstrings trace
     # to a committed number; default-on only where it runs compiled (tpu)
@@ -353,6 +370,7 @@ def main():
         "stages": stages,
         "baseline": {"traces_per_sec": round(baseline_tps, 1),
                      "n_traces": n_base, "repeats": base_repeats},
+        "compile": compile_field,
         "probe": dict(rt.probe_info,
                       **({"pipelined_probe": probe_pipelined}
                          if probe_pipelined else {})),

@@ -60,6 +60,8 @@ ENV_KNOBS: Dict[str, str] = {
     "REPORTER_TPU_SLO_MS": "per-stage p99 budgets flipping /health",
     "REPORTER_TPU_FLIGHTREC": "flight-recorder dump dir (0 disables)",
     "REPORTER_TPU_HEARTBEAT_S": "worker heartbeat interval (0 off)",
+    "REPORTER_TPU_SHADOW_SAMPLE": "shadow-oracle decode sample fraction",
+    "REPORTER_TPU_PROFILE_EVENTS": "profiler wide-event ring capacity",
 }
 
 # ---- metric names ----------------------------------------------------------
@@ -121,6 +123,20 @@ METRICS: Dict[str, str] = {
     "datastore.query.cache.misses": "partition-handle LRU misses",
     # observability
     "flightrec.dumps": "flight-recorder postmortems written",
+    # device-level profiler (obs/profiler.py)
+    "decode.compile.count": "decode dispatches that paid an XLA compile",
+    "decode.compile.recompiles": "same-shape recompiles (storm signal)",
+    "decode.compile": "XLA compile seconds per episode (timer)",
+    "decode.dispatch.first": "compiling-dispatch wall (timer)",
+    "decode.dispatch.steady": "steady-state dispatch wall (timer)",
+    "decode.occupancy.*": "per-bucket occupancy ratio histograms",
+    "decode.shadow.chunks": "chunks shadow-decoded via the numpy oracle",
+    "decode.shadow.sampled": "traces shadow-decoded via the numpy oracle",
+    "decode.shadow.mismatch": "shadow decodes scoring off the oracle",
+    "decode.shadow.mismatch_ratio": "per-chunk mismatch ratio (timer)",
+    "decode.shadow.dropped": "shadow chunks shed (sampler backlogged)",
+    "decode.shadow.errors": "shadow decode failures (chunk skipped)",
+    "profile.chunks": "wide events recorded",
 }
 
 # ---- failpoint sites -------------------------------------------------------

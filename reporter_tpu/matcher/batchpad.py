@@ -41,6 +41,26 @@ def bucket_length(n: int) -> int:
     return LENGTH_BUCKETS[min(idx, len(LENGTH_BUCKETS) - 1)]
 
 
+def kept_point_count(batch: "PaddedBatch") -> int:
+    """Kept (non-SKIP) probe points across a padded batch — the
+    occupancy numerator of the profiler's wide events. One whole-tensor
+    count over the (B, T) case codes: pad rows and padding tails are
+    all-SKIP by construction, so no per-trace view materialises."""
+    return int(np.count_nonzero(np.asarray(batch.case) != SKIP))
+
+
+def occupancy_stats(kept_points: int, rows: int, T: int
+                    ) -> "tuple[int, float, float]":
+    """(padded point cells, occupancy, padding-waste ratio) for a batch
+    padded to ``rows`` traces of bucket length ``T``. The waste ratio
+    is the fraction of decoded point slots that carry no real probe —
+    what variable-length (FLASH-style) bucketing would reclaim; the
+    candidate width K scales both sides, so it cancels."""
+    cells = rows * T
+    occ = kept_points / cells if cells else 0.0
+    return cells, occ, 1.0 - occ
+
+
 @dataclass
 class PreparedTrace:
     """One trace's fixed-width tensors, padded to bucket length T.

@@ -27,12 +27,14 @@ from ..core.tracebatch import TraceBatch, as_trace_batch
 from ..graph.network import RoadNetwork
 from ..graph.route import RouteCache
 from ..graph.spatial import SpatialGrid
+from ..obs import profiler
 from ..obs import trace as obs_trace
 from ..utils import faults, metrics
 from ..utils.circuit import CircuitBreaker
 from .assemble import assemble_segments
-from .batchpad import (LENGTH_BUCKETS, pack_batches, padded_batch_rows,
-                       prepare_batch, prepare_trace, prepare_traces_numpy)
+from .batchpad import (LENGTH_BUCKETS, kept_point_count, pack_batches,
+                       padded_batch_rows, prepare_batch, prepare_trace,
+                       prepare_traces_numpy)
 from .params import MatchParams
 
 # process-wide configuration, mirroring valhalla.Configure's module-level
@@ -40,6 +42,16 @@ from .params import MatchParams
 _global_config: dict = {}
 
 logger = logging.getLogger("reporter_tpu.matcher")
+
+
+def _route_cache_counters() -> dict:
+    """Numpy route-cache hit snapshot for a chunk's wide event (the
+    fallback-path twin of the native route-pair memo stats)."""
+    c = metrics.default.counter
+    return {"pair_hits": c("route.cache.pair_hits"),
+            "pair_misses": c("route.cache.pair_misses"),
+            "node_hits": c("route.cache.node_hits"),
+            "node_misses": c("route.cache.node_misses")}
 
 
 def _circuit_knobs() -> tuple:
@@ -517,8 +529,12 @@ class SegmentMatcher:
     def _dispatch_stage(self, batch, sigma, beta, decode_batch):
         """Dispatch lane: decode dispatch + async d2h for one chunk.
         Returns the in-flight device array without waiting on it, so the
-        next chunk's dispatch isn't gated on this one's results."""
-        with metrics.timer("matcher.decode_dispatch"):
+        next chunk's dispatch isn't gated on this one's results. The
+        profiler span attributes any XLA compile this dispatch pays to
+        the chunk's (B, T, K) shape — the compile-telemetry tap."""
+        B, T, K = batch.dist_m.shape
+        with metrics.timer("matcher.decode_dispatch"), \
+                profiler.dispatch_span(B, T, K):
             decoded, _scores = decode_batch(
                 batch.dist_m, batch.valid, batch.route_m,
                 batch.gc_m, batch.case, sigma, beta)
@@ -536,6 +552,12 @@ class SegmentMatcher:
             decoded = decoded.result()
         with metrics.timer("matcher.decode_wait"):
             decoded = np.asarray(decoded)
+        # shadow-accuracy tap: maybe re-decode this chunk through the
+        # numpy oracle on the profiler's background thread (sampled,
+        # REPORTER_TPU_SHADOW_SAMPLE; one flag-cheap call when off)
+        p0 = per_trace_params[order[0]]
+        profiler.maybe_shadow(batch, decoded, len(order),
+                              p0.effective_sigma, p0.beta)
         if batch.prep is not None:
             # native batched assembly: ONE call walks every decoded
             # path of this batch into run records; the results are lazy
@@ -620,6 +642,7 @@ class SegmentMatcher:
         """
         workers = max(1, _prep_workers())
         buckets = np.asarray(LENGTH_BUCKETS, dtype=np.int64)
+        raw_counts = np.diff(tb.offsets)  # per-trace raw point counts
         # bucket by RAW length (kept length is only known after the
         # native prep; raw is an upper bound, so a jitter-heavy trace
         # may decode in a larger bucket — same decoded path, the SKIP
@@ -669,6 +692,17 @@ class SegmentMatcher:
                                                      beta)
                             continue
                         self.circuit.record_success()
+                        # the chunk's wide event: occupancy vs the
+                        # padded (rows, T) grid, memo state, queue
+                        # depth — one call per CHUNK, not per trace
+                        profiler.chunk_event(
+                            bucket_T=int(T), K=params.max_candidates,
+                            traces=len(part),
+                            rows=int(batch.case.shape[0]),
+                            kept_points=kept_point_count(batch),
+                            raw_points=int(raw_counts[part].sum()),
+                            cache=self.runtime.route_memo_stats(),
+                            path="native")
                         submit(batch, order, sigma, beta)
 
     def _submit_numpy_chunk(self, tb: TraceBatch, part, params, pad,
@@ -690,6 +724,13 @@ class SegmentMatcher:
             # rows of a packed batch align with its traces list, so
             # order[b] is the global index of batch.traces[b]
             order = [idx_of[id(p)] for p in batch.traces]
+            profiler.chunk_event(
+                bucket_T=int(batch.case.shape[1]),
+                K=params.max_candidates, traces=len(order),
+                rows=int(batch.case.shape[0]),
+                kept_points=kept_point_count(batch),
+                raw_points=int(sum(p.num_raw for p in batch.traces)),
+                cache=_route_cache_counters(), path="numpy")
             submit(batch, order, sigma, beta)
 
     def _dispatch_fallback(self, tb: TraceBatch, per_trace_params, chunk,

@@ -23,6 +23,15 @@ crash.
   histogram timers -> ``_bucket``/``_sum``/``_count``).
 - :mod:`slo` — per-stage p99 targets (``REPORTER_TPU_SLO_MS``) that
   flip ``/health`` degraded on breach.
+- :mod:`profiler` — the device-facing half (ISSUE 8): per-shape XLA
+  compile telemetry with recompile-storm detection, per-chunk
+  bucket-occupancy/padding-waste wide events served on ``/profile``,
+  and sampled shadow decoding against the numpy oracle
+  (``REPORTER_TPU_SHADOW_SAMPLE``).
+- :mod:`ledger` — the perf-ledger library normalising every committed
+  bench artifact into ``LEDGER.jsonl`` entries (ratios + stage
+  shares, never absolutes) for ``tools/perf_gate.py``'s CI
+  regression gate.
 
 Import order matters: only the metrics-free modules load eagerly here
 (utils.metrics itself imports :mod:`trace` so every ``metrics.timer``

@@ -150,6 +150,17 @@ def dump(reason: str, extra: Optional[dict] = None) -> Optional[str]:
         # export_state's counter copy, not snapshot(): no percentile
         # math on a failure path that may be racing an os._exit
         payload["counters"] = metrics.default.export_state()[0]
+        # the last few decode chunks' wide events (occupancy, compile,
+        # queue depth) next to the span ring: what the device was
+        # chewing on when the process died. Best-effort in its OWN
+        # guard: an enrichment failure (e.g. module globals already
+        # torn down at interpreter exit) must cost the wide events,
+        # never the whole postmortem.
+        try:
+            from . import profiler  # lazy: profiler imports metrics
+            payload["wide_events"] = profiler.recent_events(16)
+        except Exception:
+            payload["wide_events"] = []
         os.makedirs(out_dir, exist_ok=True)
         path = os.path.join(out_dir,
                             f"flightrec-{os.getpid()}-{seq:04d}-{safe}.json")

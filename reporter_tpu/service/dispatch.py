@@ -18,6 +18,7 @@ import time
 from typing import Callable, List, Optional, Sequence
 
 from ..core.tracebatch import TraceBatch
+from ..obs import profiler
 from ..obs import trace as obs_trace
 from ..utils import metrics
 
@@ -159,6 +160,9 @@ class BatchDispatcher:
             self._batches += 1
             metrics.count("dispatch.batches")
             metrics.count("dispatch.traces", len(slots))
+            # backlog left behind after this drain — "queue depth at
+            # dispatch" stamped into the profiler's wide events
+            profiler.note_queue_depth(self._queue.qsize())
             # adopt one submitter's trace context so the batch's stage
             # spans parent to that request (a merged batch can only
             # follow one requester; the batch attrs record the merge)

@@ -44,8 +44,12 @@ from .report import report, report_json
 # when the service was built with a datastore attached;
 # /health is the failure-domain probe: graph, native runtime vs numpy
 # fallback, circuit state, SLO breaches, datastore reachability —
-# 200 or 503
-ACTIONS = {"report", "stats", "metrics", "histogram", "health"}
+# 200 or 503;
+# /profile is the device-level profiler (obs/profiler.py): per-shape
+# compile telemetry, per-chunk bucket-occupancy wide events, shadow-
+# accuracy verdicts
+ACTIONS = {"report", "stats", "metrics", "histogram", "health",
+           "profile"}
 
 
 class ReporterService:
@@ -144,7 +148,7 @@ class ReporterService:
         latency budget), or the datastore erroring. The body always
         enumerates every domain either way.
         """
-        from ..obs import slo
+        from ..obs import profiler, slo
         from ..utils import faults
         m = self.matcher
         circuit = m.circuit.snapshot()
@@ -156,6 +160,10 @@ class ReporterService:
                        else "fallback"},
             "circuit": circuit,
             "faults": faults.active_spec(),
+            # shadow-decode verdicts (informational here; budget the
+            # decode.shadow.mismatch_ratio histogram via
+            # REPORTER_TPU_SLO_MS to make a mismatch rate flip 503)
+            "shadow": profiler.shadow_stats(),
         }
         healthy = True
         if circuit["state"] == "open":
@@ -274,6 +282,11 @@ def make_handler(service: ReporterService):
                 from ..obs import prom
                 self._respond(200, prom.render(),
                               content_type=prom.CONTENT_TYPE)
+                return
+            if action == "profile":
+                from ..obs import profiler
+                self._respond(200, json.dumps(profiler.snapshot(),
+                                              separators=(",", ":")))
                 return
             if action == "health":
                 code, body = service.health()

@@ -30,7 +30,7 @@ import sys
 import time
 from typing import Callable, Iterable, Optional
 
-from ..obs import flightrec
+from ..obs import flightrec, profiler
 from ..obs import trace as obs_trace
 from ..utils import faults, metrics
 from ..utils import http as http_egress
@@ -220,6 +220,7 @@ class StreamWorker:
         rate = (self.processed - self._hb_processed) / dt if dt > 0 else 0.0
         self._hb_last = now
         self._hb_processed = self.processed
+        waste = profiler.padding_waste()
         logger.info("heartbeat %s", json.dumps({
             "processed": self.processed,
             "msgs_per_s": round(rate, 1),
@@ -229,6 +230,12 @@ class StreamWorker:
             "circuit": self.circuit_probe() if self.circuit_probe
             else None,
             "parse_failures": self.parse_failures,
+            # the device-compute vitals (obs/profiler.py): padding the
+            # fixed buckets pay, compile churn, shadow-oracle verdicts
+            "padding_waste": round(waste, 4) if waste is not None
+            else None,
+            "compile_count": profiler.compile_count(),
+            "shadow_mismatches": profiler.shadow_mismatches(),
         }, separators=(",", ":")))
 
     def _flush_tiles(self) -> None:

@@ -122,6 +122,14 @@ class Registry:
             self._counters[name] = v
             return v
 
+    def counter(self, name: str) -> int:
+        """One counter's current value (0 when never incremented) — a
+        cheap single-name read for telemetry consumers (the worker
+        heartbeat, the profiler's wide events) that must not pay a
+        whole-registry snapshot copy per read."""
+        with self._lock:
+            return self._counters.get(name, 0)
+
     @contextlib.contextmanager
     def timer(self, name: str) -> Iterator[None]:
         sp = _trace.span(name)  # no-op unless request tracing is armed
@@ -206,6 +214,7 @@ def snapshot_rounded(registry: "Registry | None" = None,
 #: process-global registry used by the service/worker/pipeline
 default = Registry()
 count = default.count
+counter = default.counter
 timer = default.timer
 observe = default.observe
 snapshot = default.snapshot

@@ -9,7 +9,13 @@ time, and records whether the chunked overlap path ran (``pipelined``).
 A regression in stage accounting — a renamed timer, a dropped share
 field, an artifact that stops being one JSON line — fails CI here
 instead of silently degrading the committed BENCH artifacts.
+
+``--out PATH`` additionally writes the artifact JSON to a file, which
+is what the ``perf_gate`` CI stage consumes (tools/perf_gate.py gates
+its vs_baseline ratio and stage shares against the LEDGER.jsonl
+medians — ratios, never absolutes, so box drift can't flap it).
 """
+import argparse
 import json
 import os
 import subprocess
@@ -25,7 +31,12 @@ REQUIRED_STAGES = ("prep", "decode_dispatch", "decode_wait", "assemble",
 REQUIRED_NATIVE_STAGES = ("prep_candidates", "prep_select", "prep_routes")
 
 
-def main() -> int:
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="bench_smoke")
+    parser.add_argument("--out", default=None,
+                        help="also write the bench artifact JSON here "
+                        "(consumed by the perf_gate CI stage)")
+    args = parser.parse_args(argv)
     env = dict(
         os.environ,
         REPORTER_TPU_PLATFORM="cpu",  # never contend for the chip in CI
@@ -78,8 +89,12 @@ def main() -> int:
     if not (art["value"] > 0 and art["vs_baseline"] > 0):
         sys.stderr.write("bench smoke: non-positive throughput\n")
         return 1
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            json.dump(art, f)
     print(f"bench smoke ok: {art['value']} traces/sec, "
-          f"prep_share={share}, pipelined={stages['pipelined']}")
+          f"prep_share={share}, pipelined={stages['pipelined']}"
+          + (f", artifact -> {args.out}" if args.out else ""))
     return 0
 
 

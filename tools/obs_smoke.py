@@ -14,6 +14,16 @@ Four legs, each asserting a contract the README advertises:
             p50/p95/p99 per stage timer
   slo       a breached ``REPORTER_TPU_SLO_MS`` budget flips /health 503
             with the breach named; clearing it restores 200
+  profiler  a second same-shape request adds ZERO
+            ``decode.compile.count`` (recompile-count stability);
+            ``/profile`` scrapes clean and reports a padding-waste
+            ratio in (0, 1) for a mixed-length batch; and
+            ``REPORTER_TPU_SHADOW_SAMPLE=1.0`` over the synthetic city
+            yields ``decode.shadow.mismatch == 0`` with a non-zero
+            sample count
+  perf_gate ``tools/perf_gate.py`` passes against a ledger freshly
+            seeded from the checked-in bench artifacts, and a doctored
+            candidate 20% below the ledger median fails it
   flightrec a worker SIGKILL'd by a deterministic crash failpoint
             (``worker.offer=crash``) leaves a flight-recorder
             postmortem naming the exact span in flight at death
@@ -252,9 +262,123 @@ def leg_service() -> int:
                 return fail("/health did not recover after SLO cleared")
         log("slo: breach flipped /health 503 and named the stage; "
             "clearing the spec restored 200")
+
+        # -- profiler leg ---------------------------------------------------
+        from reporter_tpu.obs import profiler
+
+        def counters():
+            with urllib.request.urlopen(f"{base}/stats") as resp:
+                return json.loads(resp.read().decode())["counters"]
+
+        c0 = counters().get("decode.compile.count", 0)
+        if c0 < 1:
+            return fail("no compile episode recorded for the first "
+                        "requests (compile telemetry dead?)")
+        code, _ = _post(f"{base}/report", req)  # SAME shape again
+        if code != 200:
+            return fail(f"repeat request failed ({code})")
+        c1 = counters().get("decode.compile.count", 0)
+        if c1 != c0:
+            return fail(f"second same-shape request recompiled: "
+                        f"decode.compile.count {c0} -> {c1}")
+
+        # mixed-length batch through one dispatcher round trip
+        mixed = []
+        for i, n_pts in enumerate((12, 25, 40)):
+            r = _request(city, f"mix-{i}", seed=20 + i)
+            r["trace"] = r["trace"][:n_pts]
+            mixed.append(r)
+        os.environ["REPORTER_TPU_SHADOW_SAMPLE"] = "1.0"
+        try:
+            reports = service.report_many(mixed)
+            if not all(r is not None for r in reports):
+                return fail("mixed-length batch had failed reports")
+            if not profiler.drain_shadow(60.0):
+                return fail("shadow decode did not drain")
+        finally:
+            os.environ.pop("REPORTER_TPU_SHADOW_SAMPLE", None)
+
+        with urllib.request.urlopen(f"{base}/profile") as resp:
+            prof = json.loads(resp.read().decode())
+        for key in ("shapes", "events", "totals", "shadow",
+                    "compile_episodes"):
+            if key not in prof:
+                return fail(f"/profile missing {key}: {sorted(prof)}")
+        if not prof["shapes"] or not prof["events"]:
+            return fail("/profile has no shapes/events after requests")
+        mixed_evs = [e for e in prof["events"] if e["traces"] >= 2]
+        if not mixed_evs:
+            return fail("no multi-trace wide event for the mixed batch")
+        waste = mixed_evs[-1]["padding_waste"]
+        if not (0.0 < waste < 1.0):
+            return fail(f"mixed-batch padding waste {waste} not in "
+                        "(0, 1)")
+        cnt = counters()
+        sampled = cnt.get("decode.shadow.sampled", 0)
+        mismatch = cnt.get("decode.shadow.mismatch", 0)
+        if sampled < len(mixed):
+            return fail(f"shadow sampled only {sampled} traces")
+        if mismatch != 0:
+            return fail(f"shadow oracle disagreed on {mismatch} of "
+                        f"{sampled} traces (accuracy drift!)")
+        storms = sum(max(0, s["compiles"] - 1) for s in prof["shapes"])
+        if storms:
+            return fail(f"recompile storm: {storms} same-shape "
+                        f"recompiles in {prof['shapes']}")
+        log(f"profiler: compile stable at {c1} episode(s) across "
+            f"repeat requests, mixed-batch padding waste {waste:.3f}, "
+            f"shadow {sampled} sampled / 0 mismatches")
         return 0
     finally:
         httpd.shutdown()
+
+
+# ---------------------------------------------------------------------------
+def leg_perf_gate() -> int:
+    """The perf ledger/gate contract: a seeded ledger passes the
+    self-check; a candidate 20% below the ledger median fails."""
+    with tempfile.TemporaryDirectory() as tmp:
+        ledger = os.path.join(tmp, "LEDGER.jsonl")
+        seed = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools",
+                                          "perf_ledger.py"),
+             "seed", "--out", ledger, "--repo", REPO],
+            capture_output=True, text=True, timeout=60)
+        if seed.returncode != 0:
+            return fail(f"perf_ledger seed rc={seed.returncode}: "
+                        f"{seed.stderr[-500:]}")
+        ok = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "perf_gate.py"),
+             "--ledger", ledger, "--self-check"],
+            capture_output=True, text=True, timeout=60)
+        if ok.returncode != 0:
+            return fail(f"perf_gate self-check failed on a clean "
+                        f"ledger: {ok.stdout[-500:]}{ok.stderr[-500:]}")
+        # doctor a candidate 20% below the cpu full-run median
+        import statistics
+        with open(ledger, encoding="utf-8") as f:
+            entries = [json.loads(line) for line in f if line.strip()]
+        pool = [e["vs_baseline"] for e in entries
+                if e.get("vs_baseline") and e.get("platform") == "cpu"
+                and e.get("scope", "full") == "full"]
+        median = statistics.median(pool)
+        doctored = os.path.join(tmp, "doctored.json")
+        with open(doctored, "w", encoding="utf-8") as f:
+            json.dump({"source": "doctored", "platform": "cpu",
+                       "scope": "full", "pipelined": False,
+                       "vs_baseline": round(median * 0.8, 2),
+                       "stage_shares": None, "kind": "bench"}, f)
+        bad = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "perf_gate.py"),
+             "--ledger", ledger, "--candidate", doctored],
+            capture_output=True, text=True, timeout=60)
+        if bad.returncode == 0:
+            return fail(f"perf_gate PASSED a 20%-regressed candidate "
+                        f"(median {median}): {bad.stdout[-500:]}")
+        log(f"perf_gate: clean self-check passed; 20%-regressed "
+            f"candidate ({median:.2f} -> {median * 0.8:.2f}) failed "
+            "as it must")
+        return 0
 
 
 # ---------------------------------------------------------------------------
@@ -324,6 +448,9 @@ def leg_flightrec() -> int:
 
 def main(argv=None) -> int:
     rc = leg_service()
+    if rc:
+        return rc
+    rc = leg_perf_gate()
     if rc:
         return rc
     rc = leg_flightrec()

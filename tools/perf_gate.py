@@ -1,0 +1,212 @@
+#!/usr/bin/env python
+"""CI perf regression gate: ratios vs the ledger median, never
+absolutes.
+
+Fails (exit 1) when a candidate bench run's ``vs_baseline`` ratio —
+batched throughput over the SAME box's single-process baseline — falls
+more than ``--tolerance`` below the median of comparable ledger
+entries, or when a stage's share of wall grows more than
+``--share-tolerance`` (absolute) above the ledger median share.
+Absolute traces/sec are never compared: bench boxes drift ~2x between
+rounds (BENCH_DEV_r06 measured it), and a gate on absolutes would flap
+on every box change. This is the "ratio-tolerance mode" ci.yml runs.
+
+Comparable = same ``platform``, a recorded ratio, and (for the share
+check) the same ``pipelined`` flag — pipelined stage seconds overlap
+the wall, so shares are only meaningful against like-pipelined runs.
+
+Usage:
+    # gate a fresh bench artifact (e.g. bench_smoke --out) against the
+    # committed ledger
+    python tools/perf_gate.py --candidate artifact.json
+
+    # ledger self-consistency: the newest comparable entry gated
+    # against the median of the rest (the CI sanity leg)
+    python tools/perf_gate.py --self-check
+
+Exit 0 prints the verdict JSON with ``"pass": true``; any regression
+prints the offending comparison and exits 1. An empty comparable pool
+passes with a note (bootstrap-friendly) unless ``--require-history``.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+from typing import List, Optional, Tuple
+
+sys.path.insert(0, os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+from reporter_tpu.obs import ledger as perf_ledger  # noqa: E402
+
+DEFAULT_TOLERANCE = 0.15
+DEFAULT_SHARE_TOLERANCE = 0.20
+
+
+def load_candidate(path: str) -> dict:
+    """A candidate entry from either a raw bench.py artifact or an
+    already-normalised ledger-entry JSON object."""
+    if path == "-":
+        d = json.load(sys.stdin)
+        source = "stdin"
+    else:
+        with open(path, encoding="utf-8") as f:
+            d = json.load(f)
+        source = os.path.basename(path)
+    if "metric" in d:  # raw bench.py artifact
+        return perf_ledger.entry_from_bench(d, source, "candidate",
+                                            "bench")
+    if "vs_baseline" in d:  # already-normalised ledger entry
+        d.setdefault("source", source)
+        d.setdefault("stage_shares", None)
+        d.setdefault("platform", None)
+        d.setdefault("pipelined", None)
+        return d
+    raise SystemExit(f"candidate {source} is neither a bench artifact "
+                     "nor a ledger entry (no vs_baseline)")
+
+
+def comparable_pool(entries: List[dict], platform: Optional[str],
+                    scope: Optional[str] = None) -> List[dict]:
+    pool = [e for e in entries
+            if e.get("vs_baseline") is not None
+            and e.get("kind") in ("bench", "bench_dev")]
+    if platform:
+        pool = [e for e in pool if e.get("platform") == platform]
+    if scope:
+        # like-scale only: a 48-trace smoke run's ratio is structurally
+        # below a 512-trace run's (amortisation) — never cross-compare
+        pool = [e for e in pool if e.get("scope", "full") == scope]
+    return pool
+
+
+def gate(candidate: dict, entries: List[dict], tolerance: float,
+         share_tolerance: float, require_history: bool
+         ) -> Tuple[bool, dict]:
+    """(passed, verdict) — the pure decision, unit-testable."""
+    platform = candidate.get("platform")
+    scope = candidate.get("scope", "full")
+    pool = comparable_pool(entries, platform, scope)
+    verdict: dict = {
+        "candidate": {"source": candidate.get("source"),
+                      "platform": platform, "scope": scope,
+                      "vs_baseline": candidate.get("vs_baseline"),
+                      "pipelined": candidate.get("pipelined")},
+        "pool": len(pool),
+        "tolerance": tolerance,
+        "share_tolerance": share_tolerance,
+        "failures": [],
+    }
+    if not pool:
+        verdict["note"] = ("no comparable ledger entries for platform="
+                           f"{platform!r} scope={scope!r}; nothing to "
+                           "gate against (append smoke-scope history "
+                           "with perf_ledger.py to make this bind)")
+        return (not require_history), verdict
+
+    median = statistics.median(e["vs_baseline"] for e in pool)
+    floor = median * (1.0 - tolerance)
+    verdict["median_vs_baseline"] = round(median, 3)
+    verdict["floor"] = round(floor, 3)
+    cand_vs = candidate.get("vs_baseline")
+    if cand_vs is None:
+        verdict["failures"].append(
+            {"check": "ratio", "reason": "candidate has no vs_baseline "
+             "(failed run?)"})
+    elif cand_vs < floor:
+        verdict["failures"].append(
+            {"check": "ratio", "candidate": cand_vs,
+             "median": round(median, 3), "floor": round(floor, 3),
+             "reason": f"vs_baseline {cand_vs} fell more than "
+             f"{tolerance:.0%} below the ledger median {median:.2f}"})
+
+    shares = candidate.get("stage_shares")
+    pipelined = candidate.get("pipelined")
+    if shares and pipelined is not None:
+        like = [e for e in pool
+                if e.get("stage_shares")
+                and e.get("pipelined") == pipelined]
+        share_medians = {}
+        for stage in perf_ledger.SHARE_STAGES:
+            vals = [e["stage_shares"][stage] for e in like
+                    if stage in e["stage_shares"]]
+            if vals:
+                share_medians[stage] = statistics.median(vals)
+        verdict["share_medians"] = {k: round(v, 4)
+                                    for k, v in share_medians.items()}
+        for stage, cand_share in shares.items():
+            med = share_medians.get(stage)
+            if med is None:
+                continue
+            if cand_share > med + share_tolerance:
+                verdict["failures"].append(
+                    {"check": "share", "stage": stage,
+                     "candidate": cand_share, "median": round(med, 4),
+                     "reason": f"{stage} share {cand_share} grew more "
+                     f"than {share_tolerance} above the ledger median "
+                     f"{med:.3f}"})
+    return (not verdict["failures"]), verdict
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="perf_gate",
+                                     description=__doc__.splitlines()[0])
+    parser.add_argument("--ledger", default=perf_ledger.DEFAULT_LEDGER)
+    parser.add_argument("--candidate",
+                        help="bench artifact or ledger-entry JSON file "
+                        "('-' for stdin)")
+    parser.add_argument("--self-check", action="store_true",
+                        help="gate the newest comparable ledger entry "
+                        "against the median of the rest")
+    parser.add_argument("--tolerance", type=float,
+                        default=DEFAULT_TOLERANCE,
+                        help="allowed relative vs_baseline drop below "
+                        "the ledger median (default 0.15)")
+    parser.add_argument("--share-tolerance", type=float,
+                        default=DEFAULT_SHARE_TOLERANCE,
+                        help="allowed absolute stage-share growth above "
+                        "the ledger median (default 0.20)")
+    parser.add_argument("--require-history", action="store_true",
+                        help="fail instead of passing when no "
+                        "comparable entries exist")
+    args = parser.parse_args(argv)
+
+    entries = perf_ledger.load_ledger(args.ledger)
+    if args.self_check:
+        # the BINDING leg: gate the newest full-scope entry (the
+        # committed-artifact lineage) against the median of the rest,
+        # with an empty pool counting as failure — appended smoke-scope
+        # history must neither become the candidate (its first entry
+        # would have no pool and pass vacuously) nor break this leg
+        pool = comparable_pool(entries, None, "full") \
+            or comparable_pool(entries, None)
+        if not pool:
+            print(json.dumps({"pass": False,
+                              "note": "self-check: empty ledger"}))
+            return 1
+        candidate = pool[-1]  # newest (ledger is append-only)
+        rest = [e for e in entries if e is not candidate]
+        passed, verdict = gate(candidate, rest, args.tolerance,
+                               args.share_tolerance,
+                               require_history=True)
+    elif args.candidate:
+        candidate = load_candidate(args.candidate)
+        passed, verdict = gate(candidate, entries, args.tolerance,
+                               args.share_tolerance,
+                               args.require_history)
+    else:
+        parser.error("need --candidate FILE or --self-check")
+        return 2  # unreachable; parser.error exits
+
+    verdict["pass"] = passed
+    print(json.dumps(verdict, separators=(",", ":")))
+    if not passed:
+        for fail in verdict["failures"]:
+            sys.stderr.write(f"perf_gate: FAIL: {fail['reason']}\n")
+    return 0 if passed else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,97 @@
+#!/usr/bin/env python
+"""CLI for the perf ledger (library: reporter_tpu/obs/ledger.py).
+
+``LEDGER.jsonl`` is the normalised perf history every
+``BENCH_r0*``/``BENCH_DEV_r0*``/``MULTICHIP_r0*`` artifact flattens
+into — vs_baseline *ratios* and per-stage *shares* of wall (never
+absolutes: bench boxes drift ~2x), each entry carrying its round's
+box-drift context note. ``tools/perf_gate.py`` gates CI against the
+ledger medians.
+
+Usage:
+    python tools/perf_ledger.py seed  [--out LEDGER.jsonl] [--repo DIR]
+    python tools/perf_ledger.py append ARTIFACT.json [--out LEDGER.jsonl]
+        [--source LABEL] [--context NOTE] [--kind KIND]
+    python tools/perf_ledger.py show  [--ledger LEDGER.jsonl]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from reporter_tpu.obs import ledger  # noqa: E402
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="perf_ledger",
+                                     description=__doc__.splitlines()[0])
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p_seed = sub.add_parser("seed", help="(re)build the ledger from the "
+                            "checked-in BENCH_*/MULTICHIP_* artifacts")
+    p_seed.add_argument("--out", default=ledger.DEFAULT_LEDGER)
+    p_seed.add_argument("--repo", default=REPO)
+
+    p_app = sub.add_parser("append", help="append one bench.py artifact")
+    p_app.add_argument("artifact", help="bench.py output JSON file, "
+                       "or '-' for stdin")
+    p_app.add_argument("--out", default=ledger.DEFAULT_LEDGER)
+    p_app.add_argument("--source", default=None,
+                       help="source label (default: the file name)")
+    p_app.add_argument("--context", default=None,
+                       help="box-drift / provenance note to carry along")
+    p_app.add_argument("--kind", default="bench",
+                       choices=("bench", "bench_dev"))
+
+    p_show = sub.add_parser("show", help="print the ledger, one line "
+                            "per entry")
+    p_show.add_argument("--ledger", default=ledger.DEFAULT_LEDGER)
+
+    args = parser.parse_args(argv)
+
+    if args.cmd == "seed":
+        entries = ledger.seed_entries(args.repo)
+        ledger.write_ledger(args.out, entries)
+        gated = sum(1 for e in entries if e["vs_baseline"] is not None)
+        print(f"seeded {len(entries)} entries ({gated} with ratios) "
+              f"-> {args.out}")
+        return 0
+
+    if args.cmd == "append":
+        if args.artifact == "-":
+            parsed = json.load(sys.stdin)
+            source = args.source or "stdin"
+        else:
+            with open(args.artifact, encoding="utf-8") as f:
+                parsed = json.load(f)
+            source = args.source or os.path.basename(args.artifact)
+        label = time.strftime("%Y-%m-%dT%H:%MZ", time.gmtime())
+        entry = ledger.entry_from_bench(parsed, source, label,
+                                        args.kind,
+                                        context=args.context)
+        with open(args.out, "a", encoding="utf-8") as f:
+            f.write(json.dumps(entry, separators=(",", ":")) + "\n")
+        print(f"appended {source} (vs_baseline="
+              f"{entry['vs_baseline']}, scope={entry['scope']}) "
+              f"-> {args.out}")
+        return 0
+
+    # show
+    for e in ledger.load_ledger(args.ledger):
+        shares = e.get("stage_shares") or {}
+        print(f"{e['label']:<24} {e['kind']:<10} "
+              f"scope={e.get('scope', 'full'):<6} "
+              f"plat={e.get('platform') or '-':<5} "
+              f"vs_baseline={e.get('vs_baseline') or '-':<7} "
+              f"prep_share={shares.get('prep', '-')}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
