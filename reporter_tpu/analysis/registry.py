@@ -62,6 +62,10 @@ ENV_KNOBS: Dict[str, str] = {
     "REPORTER_TPU_HEARTBEAT_S": "worker heartbeat interval (0 off)",
     "REPORTER_TPU_SHADOW_SAMPLE": "shadow-oracle decode sample fraction",
     "REPORTER_TPU_PROFILE_EVENTS": "profiler wide-event ring capacity",
+    "REPORTER_TPU_DEADLETTER_MAX_MB": "spool byte cap (oldest shed)",
+    "REPORTER_TPU_REPLAY_INTERVAL_S": "dead-letter drain pace (0 off)",
+    "REPORTER_TPU_REPLAY_ATTEMPTS": "replays before .quarantine",
+    "REPORTER_TPU_INGEST_LEDGER_MAX": "ingest-ledger keys/partition",
 }
 
 # ---- metric names ----------------------------------------------------------
@@ -103,6 +107,13 @@ METRICS: Dict[str, str] = {
     "state.epoch_skipped": "restores that skipped a committed epoch",
     "state.save.fail": "failed state snapshots (degraded)",
     "state.epoch_commit.fail": "failed epoch-marker commits (degraded)",
+    "matcher.assemble.quarantined": "poisoned traces spooled, chunk kept",
+    "deadletter.shed": "spool entries shed by the byte cap (oldest)",
+    "replay.traces.ok": "dead-letter traces re-submitted successfully",
+    "replay.traces.fail": "dead-letter trace replay attempts that failed",
+    "replay.tiles.ok": "dead-letter tiles re-egressed successfully",
+    "replay.tiles.fail": "dead-letter tile replay attempts that failed",
+    "replay.quarantined": "dead-letter entries moved to .quarantine",
     # pipeline
     "pipeline.gather": "backfill stage 1 (timer)",
     "pipeline.match": "backfill stage 2 (timer)",
@@ -113,6 +124,9 @@ METRICS: Dict[str, str] = {
     "datastore.ingest.dir": "directory replay (timer)",
     "datastore.ingest.quarantined": "tiles quarantined mid-ingest",
     "datastore.ingest.files": "tile files replayed",
+    "datastore.ingest.deduped": "ledger-deduped appends (exactly-once)",
+    "datastore.ingest.ledger_evicted": "ledger keys aged out by the cap",
+    "datastore.tee.deadletter": "tee-failed tiles spooled (sink was ok)",
     "datastore.query": "histogram query (timer)",
     "datastore.aggregate": "observation aggregation (timer)",
     "datastore.aggregate.rows": "observation rows aggregated",
@@ -146,6 +160,8 @@ METRICS: Dict[str, str] = {
 # scenario or a tests/test_faults.py case (FP003).
 FAULT_SITES: Dict[str, str] = {
     "native.prep": "native prep error -> circuit breaker + fallback",
+    "decode.dispatch": "device decode error -> numpy-oracle fallback",
+    "matcher.assemble": "assembly error -> per-trace scalar + quarantine",
     "matcher.submit": "report submit failure -> bounded requeue",
     "egress.http": "tile sink failure -> dead-letter spool",
     "datastore.commit": "segment commit failure -> caller quarantine",
@@ -168,6 +184,11 @@ DURABLE_MODULES: Tuple[str, ...] = (
     # the flight recorder dumps into the dead-letter layout — a torn
     # postmortem after a crash would be worse than none
     "reporter_tpu/obs/flightrec.py",
+    # the shared spool layer owns every dead-letter write (torn spool
+    # entries replay as truncation), and the drainer moves entries
+    # within the spool roots (.quarantine)
+    "reporter_tpu/utils/spool.py",
+    "reporter_tpu/streaming/drainer.py",
 )
 
 # ---- epoch-marker commit ordering (DUR004) ---------------------------------

@@ -37,14 +37,19 @@ class LocalDatastore(HistogramStore):
 
     def ingest_segments(self, segments,
                         max_deltas: Optional[int] = None,
-                        max_delta_bytes: Optional[int] = None) -> int:
+                        max_delta_bytes: Optional[int] = None,
+                        ingest_key: Optional[str] = None) -> int:
         """Zero-serialisation path: aggregate culled ``Segment`` structs
         straight out of the anonymiser's flush, no CSV round trip. With
         compaction thresholds, the touched partitions are pressure-
-        checked inline (the worker tee's automatic-compaction knobs)."""
+        checked inline (the worker tee's automatic-compaction knobs).
+        ``ingest_key`` — the flush identity the anonymiser passes its
+        tee — makes the ingest idempotent (partition manifest ledger):
+        a crash-replayed flush re-offers the same key and no-ops."""
         return self.ingest(ObservationBatch.from_segments(segments),
                            max_deltas=max_deltas,
-                           max_delta_bytes=max_delta_bytes)
+                           max_delta_bytes=max_delta_bytes,
+                           ingest_key=ingest_key)
 
     def ingest_csv(self, payload: str) -> int:
         return self.ingest(parse_tile_csv(payload))

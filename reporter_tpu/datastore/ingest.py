@@ -67,12 +67,15 @@ def parse_tile_csv(payload: str) -> ObservationBatch:
 
 def scan_tiles(root: str,
                skip_names: tuple = (".deadletter", ".traces",
-                                    ".flightrec")) -> Iterator[str]:
+                                    ".flightrec",
+                                    ".quarantine")) -> Iterator[str]:
     """Yield tile file paths under an anonymiser output (or dead-letter)
     directory, skipping the dead-letter spool, the batcher's trace-JSON
     spool (``.traces`` — request bodies, not tile CSV), the flight
-    recorder's postmortem dumps (``.flightrec`` — span JSON) and
-    dot-state files when scanning a results root."""
+    recorder's postmortem dumps (``.flightrec`` — span JSON), the
+    replayer's poison quarantine (``.quarantine`` — entries that beat
+    the replay budget, manual autopsy only) and dot-state files when
+    scanning a results root."""
     for dirpath, dirnames, filenames in os.walk(root):
         dirnames[:] = sorted(d for d in dirnames if d not in skip_names)
         for name in sorted(filenames):
@@ -81,16 +84,27 @@ def scan_tiles(root: str,
             yield os.path.join(dirpath, name)
 
 
-def ingest_file(store, path: str) -> int:
-    """Parse + aggregate + append one tile file; returns rows ingested."""
+def ingest_file(store, path: str,
+                ingest_key: Optional[str] = None) -> int:
+    """Parse + aggregate + append one tile file; returns rows ingested.
+    ``ingest_key`` (the file's flush identity — its relpath under the
+    scan root) makes the append idempotent via the partition ledger."""
     with open(path, "r", encoding="utf-8") as f:
         obs = parse_tile_csv(f.read())
-    return store.ingest(obs)
+    return store.ingest(obs, ingest_key=ingest_key)
 
 
 def ingest_dir(store, root: str, delete: bool = False,
                limit: Optional[int] = None) -> dict:
     """Replay every tile file under ``root`` into ``store``.
+
+    Exactly-once: each file's relpath under ``root`` — which IS the
+    flush identity ``{t0}_{t1}/{level}/{tile}/{source}[.writer].e{epoch}``
+    the anonymiser stamps, in the output dir and the dead-letter spool
+    alike — rides the append as its ledger key, so replaying a
+    directory the store (or the worker's tee) already ingested is a
+    counted no-op and an ``ingest --delete`` interrupted between append
+    and unlink cannot double-count on the re-run.
 
     ``delete=True`` removes each file after a successful append — the
     dead-letter replay contract (a replayed tile must not double-count
@@ -98,16 +112,18 @@ def ingest_dir(store, root: str, delete: bool = False,
     (renamed to ``.<name>.failed``, which :func:`scan_tiles` skips) for
     the same reason: a multi-partition tile may have durably committed
     some partitions' deltas before the error, so blindly replaying it
-    would double-count those. Quarantined files keep the unappended rows
-    for manual recovery. Returns ``{"files", "rows", "failures"}``.
+    would double-count those (the ledger shields exactly the partitions
+    that committed). Quarantined files keep the unappended rows for
+    manual recovery. Returns ``{"files", "rows", "failures"}``.
     """
     files = rows = failures = 0
     with metrics.timer("datastore.ingest.dir"):
         for path in scan_tiles(root):
             if limit is not None and files >= limit:
                 break
+            key = os.path.relpath(path, root).replace(os.sep, "/")
             try:
-                rows += ingest_file(store, path)
+                rows += ingest_file(store, path, ingest_key=key)
             except Exception as e:
                 logger.error("could not ingest %s (quarantining): %s",
                              path, e)

@@ -49,6 +49,12 @@ logger = logging.getLogger("reporter_tpu.datastore")
 
 MANIFEST = "MANIFEST.json"
 
+
+def _ledger_cap() -> int:
+    """Per-partition ``ingested``-ledger size cap (0 = unbounded)."""
+    from ..utils.runtime import _env_int
+    return _env_int("REPORTER_TPU_INGEST_LEDGER_MAX", 4096)
+
 _COLUMNS = (
     ("hist_key", np.int64),
     ("hist_count", np.int64),
@@ -119,8 +125,32 @@ class HistogramStore:
                                json.dumps(manifest))
 
     # -- write path --------------------------------------------------------
-    def append(self, level: int, index: int, delta: Delta) -> str:
-        """Commit one delta as a new immutable segment; returns its name."""
+    def append(self, level: int, index: int, delta: Delta,
+               ingest_key: Optional[str] = None) -> Optional[str]:
+        """Commit one delta as a new immutable segment; returns its name.
+
+        ``ingest_key`` is the exactly-once idempotency key (ISSUE 9):
+        the flush-file identity ``{t0}_{t1}/{level}/{tile}/{source}
+        [.writer].e{epoch}`` every producer path derives the same way —
+        the worker tee from its flush epoch, directory replays from the
+        tile file's relpath. The partition manifest carries an
+        ``ingested`` ledger of keys it has committed; a key already in
+        the ledger makes this append a counted no-op (returns None), so
+        a crash-replayed tee flush or an interrupted ``ingest --delete``
+        re-run leaves the store BYTE-IDENTICAL instead of double
+        counting. Ledger entry and segment commit share the one atomic
+        manifest write, so there is no window where one is durable
+        without the other.
+
+        The ledger is bounded: a long-lived tee adds one key per flush
+        per touched partition forever, and the whole manifest is
+        re-serialised on every append, so an unbounded ledger turns
+        into O(n^2) cumulative manifest I/O. Beyond
+        ``REPORTER_TPU_INGEST_LEDGER_MAX`` keys (insertion-ordered;
+        oldest evicted first, ``datastore.ingest.ledger_evicted``) the
+        dedupe window slides: the newest N flushes per partition stay
+        idempotent — replays older than that must rely on ``ingest
+        --delete`` having removed their files."""
         # failure domain: a failed commit surfaces to the caller (the
         # worker tee logs-and-continues; `datastore ingest` quarantines
         # the tile) and the crash-safe protocol below leaves only an
@@ -130,11 +160,29 @@ class HistogramStore:
             pdir = self.partition_dir(level, index)
             os.makedirs(pdir, exist_ok=True)
             manifest = self._read_manifest(pdir)
+            if ingest_key is not None \
+                    and ingest_key in manifest.get("ingested", {}):
+                metrics.count("datastore.ingest.deduped")
+                logger.info("dedupe: %s already ingested into %d/%d "
+                            "(segment %s); skipping", ingest_key, level,
+                            index, manifest["ingested"][ingest_key])
+                return None
             seq = manifest["seq"] + 1
             name = f"delta-{seq:06d}"
             self._write_segment(pdir, name, delta)
             manifest["seq"] = seq
             manifest["segments"] = manifest["segments"] + [name]
+            if ingest_key is not None:
+                ingested = dict(manifest.get("ingested", {}))
+                ingested[ingest_key] = name
+                cap = _ledger_cap()
+                if cap and len(ingested) > cap:
+                    evicted = len(ingested) - cap
+                    for old in list(ingested)[:evicted]:
+                        del ingested[old]
+                    metrics.count("datastore.ingest.ledger_evicted",
+                                  evicted)
+                manifest["ingested"] = ingested
             self._write_manifest(pdir, manifest)
             return name
 
@@ -163,15 +211,20 @@ class HistogramStore:
 
     def ingest(self, obs: ObservationBatch,
                max_deltas: Optional[int] = None,
-               max_delta_bytes: Optional[int] = None) -> int:
+               max_delta_bytes: Optional[int] = None,
+               ingest_key: Optional[str] = None) -> int:
         """Aggregate + append a whole observation batch (possibly spanning
-        partitions). Returns the number of valid rows ingested. With
-        compaction thresholds set, each partition THIS batch touched is
-        pressure-checked right after its append — O(touched partitions),
-        not a store-wide sweep (the worker tee runs this on every flush)."""
+        partitions). Returns the number of valid rows ingested — rows a
+        partition's ledger deduped (``ingest_key`` already committed
+        there) are not counted. With compaction thresholds set, each
+        partition THIS batch touched is pressure-checked right after its
+        append — O(touched partitions), not a store-wide sweep (the
+        worker tee runs this on every flush)."""
         rows = 0
         for (level, index), delta in aggregate(obs).items():
-            self.append(level, index, delta)
+            if self.append(level, index, delta,
+                           ingest_key=ingest_key) is None:
+                continue
             rows += delta.rows
             if max_deltas is not None or max_delta_bytes is not None:
                 self._maybe_compact_partition(level, index, max_deltas,
@@ -307,7 +360,13 @@ class HistogramStore:
             seq = manifest["seq"] + 1
             base = f"base-{seq:06d}"
             self._write_segment(pdir, base, merge_deltas(deltas))
-            self._write_manifest(pdir, {"seq": seq, "segments": [base]})
+            # the ingested ledger survives compaction: the merged base
+            # still CONTAINS those flushes, so dropping their keys would
+            # re-open the double-ingest window the ledger closes
+            compacted = {"seq": seq, "segments": [base]}
+            if manifest.get("ingested"):
+                compacted["ingested"] = manifest["ingested"]
+            self._write_manifest(pdir, compacted)
             # the new manifest is durable; merged segment dirs are dead
             for name in names:
                 shutil.rmtree(os.path.join(pdir, name), ignore_errors=True)

@@ -349,16 +349,32 @@ class SegmentMatcher:
             elif use_native:
                 raise RuntimeError("native host runtime requested but "
                                    "unavailable")
-        # failure domain for native prep: N consecutive prep errors open
-        # the circuit and route whole chunks through the numpy fallback
-        # (outputs pinned byte-identical by tests/test_report_writer.py);
-        # a half-open probe after the cooldown feels out recovery. The
-        # breaker exists even without a runtime (it just never trips) so
-        # /health can always report a state.
+        # failure domains, one breaker per hot-path stage (shared
+        # threshold/cooldown knobs):
+        #   circuit           native prep -> numpy prep fallback
+        #   circuit_decode    device decode -> per-trace numpy oracle
+        #                     (cpu_ref.viterbi_decode_numpy)
+        #   circuit_assemble  native batched assembly -> per-trace scalar
+        #                     assembly with poisoned-trace quarantine
+        # Fallback outputs are pinned byte-identical (tests/
+        # test_report_writer.py, TestDecodeDomain); a half-open probe
+        # after the cooldown feels out recovery. The breakers exist even
+        # without a runtime/device (they just never trip) so /health can
+        # always report every domain's state.
         threshold, cooldown = _circuit_knobs()
         self.circuit = CircuitBreaker("matcher.circuit",
                                       threshold=threshold,
                                       cooldown_s=cooldown)
+        self.circuit_decode = CircuitBreaker("matcher.circuit.decode",
+                                             threshold=threshold,
+                                             cooldown_s=cooldown)
+        self.circuit_assemble = CircuitBreaker("matcher.circuit.assemble",
+                                               threshold=threshold,
+                                               cooldown_s=cooldown)
+        # where a poisoned trace's request JSON lands when assembly
+        # quarantines it (None -> the worker-registered trace spool via
+        # utils.spool, else log-and-drop)
+        self.quarantine_spool: Optional[str] = None
         # two single-worker device lanes, each FIFO: the dispatch lane
         # runs decode dispatch + async d2h so the device queue stays fed,
         # the drain lane runs the d2h wait + assembly — so chunk N's
@@ -388,6 +404,23 @@ class SegmentMatcher:
                 if self._route_cache is None:
                     self._route_cache = RouteCache(self.net)
         return self._route_cache
+
+    # -- failure-domain surface --------------------------------------------
+    #: domain name -> breaker attribute; the /health "degraded" block,
+    #: the worker heartbeat and the chaos assertions all read this map
+    CIRCUIT_DOMAINS = (("native.prep", "circuit"),
+                       ("decode.dispatch", "circuit_decode"),
+                       ("matcher.assemble", "circuit_assemble"))
+
+    def circuit_snapshots(self) -> dict:
+        """{domain: breaker snapshot} for every guarded hot-path stage."""
+        return {domain: getattr(self, attr).snapshot()
+                for domain, attr in self.CIRCUIT_DOMAINS}
+
+    def open_domains(self) -> List[str]:
+        """Domains currently open (serving degraded) — [] when healthy."""
+        return [domain for domain, attr in self.CIRCUIT_DOMAINS
+                if getattr(self, attr).snapshot()["state"] == "open"]
 
     # -- single-trace, reference-shaped API --------------------------------
     def Match(self, trace_json: str) -> str:
@@ -473,13 +506,13 @@ class SegmentMatcher:
                     sigma, beta, decode_batch)
                 futures.append((d_fut, self._drain_pool.submit(
                     self._lane_stage, ctx, self._drain_stage, batch,
-                    order, d_fut, per_trace_params, results)))
+                    order, d_fut, per_trace_params, results, tb)))
         else:
             def submit(batch, order, sigma, beta):
                 decoded = self._dispatch_stage(batch, sigma, beta,
                                                decode_batch)
                 self._drain_stage(batch, order, decoded,
-                                  per_trace_params, results)
+                                  per_trace_params, results, tb)
 
         try:
             if self.runtime is not None:
@@ -531,23 +564,75 @@ class SegmentMatcher:
         Returns the in-flight device array without waiting on it, so the
         next chunk's dispatch isn't gated on this one's results. The
         profiler span attributes any XLA compile this dispatch pays to
-        the chunk's (B, T, K) shape — the compile-telemetry tap."""
+        the chunk's (B, T, K) shape — the compile-telemetry tap.
+
+        Failure domain: a dispatch that raises (device lost, compile
+        failure, injected ``decode.dispatch`` fault) degrades THAT chunk
+        to the per-trace numpy oracle and counts a ``circuit_decode``
+        failure; enough consecutive failures open the circuit and later
+        chunks skip the device entirely until a half-open probe
+        succeeds — the decode twin of the native-prep breaker."""
         B, T, K = batch.dist_m.shape
         with metrics.timer("matcher.decode_dispatch"), \
                 profiler.dispatch_span(B, T, K):
-            decoded, _scores = decode_batch(
-                batch.dist_m, batch.valid, batch.route_m,
-                batch.gc_m, batch.case, sigma, beta)
-            if hasattr(decoded, "copy_to_host_async"):
-                decoded.copy_to_host_async()
+            if not self.circuit_decode.allow():
+                metrics.count("matcher.circuit.decode.fallback_chunks")
+                return self._decode_numpy_chunk(batch, sigma, beta)
+            try:
+                faults.failpoint("decode.dispatch")
+                decoded, _scores = decode_batch(
+                    batch.dist_m, batch.valid, batch.route_m,
+                    batch.gc_m, batch.case, sigma, beta)
+                if hasattr(decoded, "copy_to_host_async"):
+                    decoded.copy_to_host_async()
+            except Exception as e:
+                self.circuit_decode.record_failure()
+                metrics.count("matcher.circuit.decode.errors")
+                logger.warning(
+                    "device decode failed for a (%d, %d, %d) chunk (%s); "
+                    "decoding it via the numpy oracle", B, T, K, e)
+                return self._decode_numpy_chunk(batch, sigma, beta)
+            self.circuit_decode.record_success()
         return decoded
 
+    def _decode_numpy_chunk(self, batch, sigma, beta) -> np.ndarray:
+        """Degraded decode: the per-trace numpy Viterbi oracle
+        (cpu_ref.viterbi_decode_numpy — the same implementation the
+        shadow-accuracy sampler scores the device against) over every
+        row of the chunk. Consumes the SAME prepared tensors as the
+        device kernels, so on the scan backend (the single-device CPU
+        default) the paths — and therefore the report bytes — are
+        bit-identical (pinned by TestDecodeDomain); tie-breaks may
+        differ only vs the associative-scan backend, where equal-score
+        paths already diverge between device backends."""
+        from .cpu_ref import viterbi_decode_numpy
+        dist = np.asarray(batch.dist_m, dtype=np.float32)
+        valid = np.asarray(batch.valid)
+        T = dist.shape[1]
+        # the native prep path carries a dead trailing time row (T rows,
+        # for seq sharding); the oracle wants the documented T-1
+        route = np.asarray(batch.route_m[:, :max(T - 1, 0)],
+                           dtype=np.float32)
+        gc = np.asarray(batch.gc_m[:, :max(T - 1, 0)], dtype=np.float32)
+        case = np.asarray(batch.case)
+        # rows past len(batch.traces) are all-SKIP pow2/mesh padding the
+        # device batch carries; assembly never reads them (decoded[:B]),
+        # so the oracle must not pay a full Viterbi per filler row —
+        # degraded mode is exactly when throughput is scarcest
+        out = np.zeros(dist.shape[:2], dtype=np.int32)
+        for b in range(len(batch.traces)):
+            out[b], _score = viterbi_decode_numpy(
+                dist[b], valid[b], route[b], gc[b], case[b], sigma, beta)
+        return out
+
     def _drain_stage(self, batch, order, decoded, per_trace_params,
-                     results) -> None:
+                     results, tb=None) -> None:
         """Drain lane: d2h wait + assembly + result formatting for one
         chunk. ``decoded`` is the dispatch stage's device array, or a
         Future of it on the pipelined path; writes into ``results`` slots
-        owned exclusively by this chunk's ``order``."""
+        owned exclusively by this chunk's ``order``. ``tb`` is the call's
+        TraceBatch — the source the poisoned-trace quarantine rebuilds a
+        replayable request body from."""
         if hasattr(decoded, "result"):
             decoded = decoded.result()
         with metrics.timer("matcher.decode_wait"):
@@ -563,35 +648,96 @@ class SegmentMatcher:
             # path of this batch into run records; the results are lazy
             # MatchRuns views over ONE shared RunColumns — no per-run
             # dicts here, the serving path serialises straight from the
-            # columns (render_segments_json / service report_json)
-            B = len(batch.traces)
-            gp = per_trace_params[order[0]]
-            with metrics.timer("matcher.assemble"):
-                runs = self.runtime.assemble_batch(
-                    decoded[:B], batch.prep, batch.pt_off,
-                    batch.times_flat,
-                    queue_threshold_kph=gp.queue_speed_threshold_kph,
-                    interpolation_distance_m=gp.interpolation_distance,
-                    backward_tolerance_m=gp.backward_tolerance_m,
-                    turn_penalty_factor=gp.turn_penalty_factor)
-                ro = runs["run_off"].tolist()
-                cols = RunColumns(runs)
-                for b, i in enumerate(order):
-                    results[i] = MatchRuns(cols, ro[b], ro[b + 1],
-                                           per_trace_params[i].mode)
-        else:
-            # order is elementwise-aligned with batch.traces (the
-            # dispatchers build it that way), so row b IS trace order[b]
-            with metrics.timer("matcher.assemble"):
-                for b, i in enumerate(order):
-                    p = batch.traces[b]
-                    params = per_trace_params[i]
+            # columns (render_segments_json / service report_json).
+            # Failure domain: one poisoned trace used to fail the WHOLE
+            # chunk here; now a failed batch call counts a
+            # ``circuit_assemble`` failure and the chunk degrades to the
+            # per-trace scalar assembler below, which isolates the
+            # poison to its own trace.
+            if self.circuit_assemble.allow():
+                B = len(batch.traces)
+                gp = per_trace_params[order[0]]
+                try:
+                    with metrics.timer("matcher.assemble"):
+                        faults.failpoint("matcher.assemble")
+                        runs = self.runtime.assemble_batch(
+                            decoded[:B], batch.prep, batch.pt_off,
+                            batch.times_flat,
+                            queue_threshold_kph=gp.queue_speed_threshold_kph,
+                            interpolation_distance_m=gp.interpolation_distance,
+                            backward_tolerance_m=gp.backward_tolerance_m,
+                            turn_penalty_factor=gp.turn_penalty_factor)
+                        ro = runs["run_off"].tolist()
+                        cols = RunColumns(runs)
+                        for b, i in enumerate(order):
+                            results[i] = MatchRuns(
+                                cols, ro[b], ro[b + 1],
+                                per_trace_params[i].mode)
+                except Exception as e:
+                    self.circuit_assemble.record_failure()
+                    metrics.count("matcher.circuit.assemble.native_errors")
+                    logger.warning(
+                        "batched assembly failed for a %d-trace chunk "
+                        "(%s); assembling it per trace", len(order), e)
+                else:
+                    self.circuit_assemble.record_success()
+                    return
+            else:
+                metrics.count("matcher.circuit.assemble.fallback_chunks")
+        # per-trace scalar assembly — the numpy-path default AND the
+        # assemble-domain degraded mode: each trace assembles in its own
+        # try, so a poisoned trace quarantines alone instead of failing
+        # the chunk. order is elementwise-aligned with batch.traces (the
+        # dispatchers build it that way), so row b IS trace order[b].
+        with metrics.timer("matcher.assemble"):
+            for b, i in enumerate(order):
+                params = per_trace_params[i]
+                try:
+                    faults.failpoint("matcher.assemble")
                     results[i] = assemble_segments(
-                        self.net, p, decoded[b], mode=params.mode,
+                        self.net, batch.traces[b], decoded[b],
+                        mode=params.mode,
                         queue_threshold_kph=params.queue_speed_threshold_kph,
                         interpolation_distance_m=params.interpolation_distance,
                         backward_tolerance_m=params.backward_tolerance_m,
                         turn_penalty_factor=params.turn_penalty_factor)
+                except Exception as e:
+                    self._quarantine_trace(tb, int(i), e)
+                    # the caller still gets a well-formed (empty) match
+                    # for the poisoned slot; every other trace's bytes
+                    # are unchanged (pinned by TestAssembleDomain).
+                    # Dict-per-poisoned-trace is the cold quarantine
+                    # path, not the per-trace steady state.
+                    results[i] = {"segments": [],  # lint: ignore[HP002]
+                                  "mode": params.mode}
+
+    def _quarantine_trace(self, tb, i: int, err: Exception) -> None:
+        """Spool a poisoned trace's request JSON (/report-ready — the
+        dead-letter replayer re-submits it verbatim) to the trace
+        dead-letter spool; best-effort, counted either way."""
+        metrics.count("matcher.assemble.quarantined")
+        from ..utils import spool
+        root = self.quarantine_spool or spool.trace_dir()
+        uuid = tb.uuid(i) if tb is not None else None
+        if root is None or tb is None:
+            logger.error("quarantined poisoned trace %s (%s) with no "
+                         "dead-letter spool configured", uuid, err)
+            return
+        try:
+            body = tb[i].to_request()
+            # deterministic per-uuid name: when the dead-letter REPLAY
+            # of this body poisons again, the re-quarantine overwrites
+            # this entry instead of minting a fresh one — the drainer's
+            # shared uuid budget can then converge it to .quarantine
+            # rather than chase an ever-growing family of copies
+            name = f"poison.{uuid or 'anon'}.json"
+            path = spool.write(root, name,
+                               json.dumps(body, separators=(",", ":")))
+            logger.warning("quarantined poisoned trace %s -> %s (%s)",
+                           uuid, path, err)
+        except Exception as spool_err:  # never fail the chunk for this
+            logger.error("poisoned-trace quarantine failed for %s: %s "
+                         "(original error: %s)", uuid, spool_err, err)
 
     # every param that shapes the prepared tensors or the batched
     # assembly: traces may only share one native prep call (and one device

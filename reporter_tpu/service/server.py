@@ -149,9 +149,10 @@ class ReporterService:
         enumerates every domain either way.
         """
         from ..obs import profiler, slo
-        from ..utils import faults
+        from ..utils import faults, spool
         m = self.matcher
         circuit = m.circuit.snapshot()
+        open_domains = m.open_domains()
         body = {
             "graph": {"loaded": m.net is not None,
                       "nodes": int(m.net.num_nodes),
@@ -159,6 +160,16 @@ class ReporterService:
             "native": {"status": "native" if m.runtime is not None
                        else "fallback"},
             "circuit": circuit,
+            # every guarded hot-path domain by name (ISSUE 9): which
+            # breakers are open (serving via their fallback) and each
+            # domain's full breaker state — a load balancer rotates on
+            # "open", an operator reads "domains" to see which stage
+            "degraded": {"open": open_domains,
+                         "domains": m.circuit_snapshots()},
+            # dead-letter backlog gauges (worker-registered spool roots;
+            # zeros when this process runs no worker): a drain stall is
+            # visible here long before the disk fills
+            "deadletter": spool.backlog_snapshot(),
             "faults": faults.active_spec(),
             # shadow-decode verdicts (informational here; budget the
             # decode.shadow.mismatch_ratio histogram via
@@ -166,7 +177,7 @@ class ReporterService:
             "shadow": profiler.shadow_stats(),
         }
         healthy = True
-        if circuit["state"] == "open":
+        if open_domains:
             healthy = False
         slo_check = slo.check()
         body["slo"] = {"targets": {k: round(v * 1000.0, 3) for k, v

@@ -27,7 +27,7 @@ from typing import Dict, List, Optional
 from ..core.types import Segment, TimeQuantisedTile
 from ..obs import flightrec
 from ..obs import trace as obs_trace
-from ..utils import faults, fsio
+from ..utils import faults, fsio, spool
 from ..utils import http as http_egress
 from ..utils import metrics
 
@@ -124,24 +124,37 @@ class TileSink:
             metrics.count("egress.ok")
             return True
         metrics.count("egress.fail")
-        self._spool(tile_name, file_name, payload)
+        self.spool_tile(tile_name, file_name, payload)
         return False
 
-    def _spool(self, tile_name: str, file_name: str, payload: str) -> None:
+    def spool_tile(self, tile_name: str, file_name: str, payload: str,
+                   reason: str = "egress") -> None:
+        """Spool a tile body for later replay. ``reason`` labels WHOSE
+        failure this is — ``egress`` (this sink, the default) counts
+        ``egress.deadletter``; the anonymiser passes ``tee`` for a
+        datastore-tee failure with successful egress, counted
+        ``datastore.tee.deadletter`` so an alert on the egress metric
+        never rotates a healthy sink over a datastore fault."""
         try:
-            path = os.path.join(self.deadletter, tile_name)
-            os.makedirs(path, exist_ok=True)
             # atomic spool (reporter-lint DUR001): a torn dead-letter
             # body would replay as a silently-truncated tile — ingest
-            # drops malformed rows rather than failing the file
-            fsio.atomic_write_text(os.path.join(path, file_name),
-                                   payload)
-            metrics.count("egress.deadletter")
-            logger.warning("Spooled failed tile to %s/%s/%s",
-                           self.deadletter, tile_name, file_name)
-            # a tile in the spool means the sink is failing: leave a
-            # postmortem of what led up to it
-            flightrec.dump("deadletter.tile",
+            # drops malformed rows rather than failing the file. The
+            # shared spool layer also enforces the byte cap
+            # (REPORTER_TPU_DEADLETTER_MAX_MB, oldest shed first): a
+            # dead sink must not fill the disk at stream rate
+            spool.write(self.deadletter, tile_name + "/" + file_name,
+                        payload)
+            # two literal count sites, not one conditional expression:
+            # the registry-drift lint attributes literal metric names
+            if reason == "egress":
+                metrics.count("egress.deadletter")
+            else:
+                metrics.count("datastore.tee.deadletter")
+            logger.warning("Spooled failed tile (%s failure) to %s/%s/%s",
+                           reason, self.deadletter, tile_name, file_name)
+            # a tile in the spool means a consumer is failing: leave a
+            # postmortem of what led up to it, naming which one
+            flightrec.dump(f"deadletter.tile.{reason}",
                            {"tile": tile_name, "file": file_name})
         except Exception as e:  # spool is best-effort: never raise
             logger.error("Dead-letter spool failed for %s/%s: %s",
@@ -177,8 +190,22 @@ class Anonymiser:
         # optional callable(tile, segments) fed every culled flush before
         # egress — the zero-serialisation hook a co-located datastore uses
         # (datastore.LocalDatastore.ingest_segments); a tee failure is
-        # logged but never blocks tile egress
+        # logged but never blocks tile egress. A tee accepting an
+        # ``ingest_key`` kwarg additionally receives the flush identity
+        # (the exactly-once ledger key ``{tile_name}/{file_name}`` —
+        # identical to the tile file's relpath a directory replay would
+        # derive), detected once here so legacy two-arg tees keep working
         self.tee = tee
+        self._tee_wants_key = False
+        if tee is not None:
+            import inspect
+            try:
+                params = inspect.signature(tee).parameters.values()
+                self._tee_wants_key = any(
+                    p.name == "ingest_key" or p.kind == p.VAR_KEYWORD
+                    for p in params)
+            except (TypeError, ValueError):  # builtins/partials: legacy
+                pass
         # monotonic flush epoch: stamped into every tile file name this
         # flush emits (the sink idempotency key) and carried in the
         # StateStore snapshot. The reference named files {source}.{uuid4}
@@ -246,19 +273,30 @@ class Anonymiser:
                     tile, before, len(segments))
                 if not segments:
                     continue
+                tile_name = "{}_{}/{}/{}".format(
+                    tile.time_range_start,
+                    tile.time_range_start + self.quantisation - 1,
+                    tile.tile_level(), tile.tile_index())
+                tee_ok = True
                 if self.tee is not None:
                     try:
-                        self.tee(tile, segments)
+                        if self._tee_wants_key:
+                            # the flush identity == the tile file's
+                            # relpath: tee ingest and a later directory
+                            # replay of the same flush derive the SAME
+                            # ledger key, so they dedupe against each
+                            # other (end-to-end exactly-once)
+                            self.tee(tile, segments,
+                                     ingest_key=f"{tile_name}/{file_name}")
+                        else:
+                            self.tee(tile, segments)
                     except Exception as e:
+                        tee_ok = False
                         logger.error("datastore tee failed for tile %s: %s",
                                      tile, e)
                 payload = "\n".join(
                     [Segment.column_layout()]
                     + [s.csv_row(self.mode, self.source) for s in segments])
-                tile_name = "{}_{}/{}/{}".format(
-                    tile.time_range_start,
-                    tile.time_range_start + self.quantisation - 1,
-                    tile.tile_level(), tile.tile_index())
                 logger.info("Writing tile to %s/%s/%s with %d segments",
                             self.sink.output, tile_name, file_name,
                             len(segments))
@@ -267,6 +305,18 @@ class Anonymiser:
                     ok = self.sink.store(tile_name, file_name, payload)
                 if ok:
                     written += 1
+                    if not tee_ok and hasattr(self.sink, "spool_tile"):
+                        # egress succeeded but the datastore ingest did
+                        # not: without a spool entry this observation
+                        # would live in the tile file and NEVER in the
+                        # store (the loss path bigreplay's exactly-once
+                        # parity check catches). Spool it so the drainer
+                        # replays it into the datastore — the ledger key
+                        # dedupes the already-egressed sink side.
+                        # (reason="tee": a datastore fault must not be
+                        # counted — or alerted — as a sink failure)
+                        self.sink.spool_tile(tile_name, file_name,
+                                             payload, reason="tee")
         # drop unreferenced slices (reference: :258-265)
         for name in list(self.slices):
             logger.warning("Deleting unreferenced quantised tile slice %s",

@@ -42,7 +42,7 @@ from ..core.tracebatch import TraceBatch, TraceView
 from ..core.types import Point, Segment
 from ..obs import flightrec
 from ..obs import trace as obs_trace
-from ..utils import faults, metrics
+from ..utils import faults, metrics, spool
 
 logger = logging.getLogger("reporter_tpu.streaming")
 
@@ -357,11 +357,11 @@ class PointBatcher:
         name = f"trace-{os.getpid()}-{self._deadletter_seq:06d}" \
                f".{uuid}.json"
         try:
-            os.makedirs(self.deadletter_dir, exist_ok=True)
-            path = os.path.join(self.deadletter_dir, name)
-            with open(path + ".tmp", "w", encoding="utf-8") as f:
-                json.dump(body, f, separators=(",", ":"))
-            os.replace(path + ".tmp", path)
+            # shared spool layer: atomic commit (these bodies replay
+            # through the drainer / replay_cli) + the byte cap with
+            # oldest-first shedding (REPORTER_TPU_DEADLETTER_MAX_MB)
+            path = spool.write(self.deadletter_dir, name,
+                               json.dumps(body, separators=(",", ":")))
             metrics.count("batch.deadletter")
             logger.warning("Dead-lettered trace for %s -> %s", uuid, path)
             # a dead-lettered trace means the matcher stayed down past

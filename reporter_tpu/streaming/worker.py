@@ -34,8 +34,10 @@ from ..obs import flightrec, profiler
 from ..obs import trace as obs_trace
 from ..utils import faults, metrics
 from ..utils import http as http_egress
+from ..utils import spool as spool_mod
 from .anonymiser import Anonymiser, TileSink
 from .batcher import PointBatcher, SESSION_GAP_MS
+from .drainer import DeadLetterDrainer, replay_knobs
 from .formatter import Formatter
 
 logger = logging.getLogger("reporter_tpu.streaming")
@@ -85,7 +87,9 @@ class StreamWorker:
                  submit_many=None,
                  report_flush_interval_s: float = 1.0,
                  trace_deadletter: Optional[str] = None,
-                 circuit_probe: Optional[Callable[[], str]] = None):
+                 circuit_probe: Optional[Callable[[], str]] = None,
+                 degraded_probe: Optional[Callable[[], list]] = None,
+                 datastore=None):
         self.formatter = formatter
         # multi-host: predicate deciding which uuids this worker owns
         # (parallel.multihost — the Kafka keyed-partition contract when the
@@ -107,6 +111,33 @@ class StreamWorker:
         # an explicit REPORTER_TPU_FLIGHTREC wins inside set_dump_dir
         if spool:
             flightrec.set_dump_dir(os.path.join(spool, ".flightrec"))
+        # register the spool roots so the matcher's poisoned-trace
+        # quarantine and /health's backlog gauges find them without
+        # plumbing (utils.spool, module-level like the flight recorder).
+        # Last-writer-wins: a multi-worker process (tools/bigreplay.py)
+        # must wire matcher.quarantine_spool per matcher instead of
+        # relying on these globals, and the heartbeat below reads the
+        # per-instance roots, not the globals
+        self._tile_spool = spool or None
+        self._trace_spool = trace_deadletter
+        spool_mod.set_tile_dir(spool or None)
+        spool_mod.set_trace_dir(trace_deadletter)
+        # automated dead-letter replay (ISSUE 9): paced on THIS thread
+        # via maybe_punctuate (the anonymiser/batcher have no locks),
+        # re-submitting spooled traces through the live submit path and
+        # re-egressing spooled tiles through the live sink.
+        # REPORTER_TPU_REPLAY_INTERVAL_S=0 (default) disables.
+        replay_interval, replay_attempts = replay_knobs()
+        self.drainer = None
+        if replay_interval > 0 and (spool or trace_deadletter):
+            self.drainer = DeadLetterDrainer(
+                spool or None, trace_root=trace_deadletter,
+                submit=submit,
+                forward=lambda key, seg: self.anonymiser.process(key, seg),
+                sink=getattr(anonymiser, "sink", None),
+                datastore=datastore,
+                interval_s=replay_interval,
+                max_attempts=replay_attempts)
         self.batcher = PointBatcher(
             submit, lambda key, seg: self.anonymiser.process(key, seg),
             mode=mode, report_on=reports, transition_on=transitions,
@@ -133,8 +164,10 @@ class StreamWorker:
         from ..utils.runtime import _env_float
         self.heartbeat_s = _env_float("REPORTER_TPU_HEARTBEAT_S", 0.0)
         # circuit-state probe for the heartbeat (in-process deployments
-        # pass the matcher's breaker; HTTP splits have none to read)
+        # pass the matcher's breaker; HTTP splits have none to read);
+        # degraded_probe names the OPEN domains (matcher.open_domains)
         self.circuit_probe = circuit_probe
+        self.degraded_probe = degraded_probe
         self._hb_last = time.monotonic()
         self._hb_processed = 0
         # durable state (StateStore): restore open batches + tile slices
@@ -203,6 +236,8 @@ class StreamWorker:
             except Exception as e:
                 metrics.count("state.save.fail")
                 logger.error("state snapshot failed (will retry): %s", e)
+        if self.drainer is not None:
+            self.drainer.maybe_drain()
         if self.heartbeat_s > 0:
             self._maybe_heartbeat()
 
@@ -229,6 +264,18 @@ class StreamWorker:
             "flush_epoch": self.anonymiser.flush_epoch,
             "circuit": self.circuit_probe() if self.circuit_probe
             else None,
+            # which guarded domains are serving degraded right now
+            # (open breakers; [] = all closed, None = no probe wired)
+            "degraded": self.degraded_probe() if self.degraded_probe
+            else None,
+            # dead-letter backlog gauges: a drain stall shows up as a
+            # growing spool long before the disk alarm does. THIS
+            # worker's roots, not the module globals — in a multi-worker
+            # process every heartbeat must gauge its own spools (TTL-
+            # cached: a full spool must not turn heartbeats into walks)
+            "deadletter": {
+                "tiles": spool_mod.backlog_cached(self._tile_spool),
+                "traces": spool_mod.backlog_cached(self._trace_spool)},
             "parse_failures": self.parse_failures,
             # the device-compute vitals (obs/profiler.py): padding the
             # fixed buckets pay, compile churn, shadow-oracle verdicts
@@ -279,8 +326,12 @@ class StreamWorker:
                              epoch, e)
 
     def drain(self) -> None:
-        """End of stream: evict every open batch and flush all tiles."""
+        """End of stream: evict every open batch, give the dead-letter
+        replayer a final drain (replayed traces' segments make this last
+        flush instead of stranding in the spool), and flush all tiles."""
         self.batcher.punctuate(int(self.clock() * 1000) + 10 * self.session_gap_ms)
+        if self.drainer is not None:
+            self.drainer.drain_now()
         self._flush_tiles()
         if self.state is not None:
             self.state.save(self.batcher, self.anonymiser)
@@ -406,6 +457,7 @@ def main(argv=None):
     uuid_filter = resolve_uuid_filter(args.uuid_filter, args.bootstrap)
 
     circuit_probe = None
+    degraded_probe = None
     if args.reporter_url:
         submit = http_submitter(args.reporter_url)
         submit_many = None  # HTTP path: one POST per trace (split deploy)
@@ -422,6 +474,7 @@ def main(argv=None):
         # -> one padded device batch (ReporterService.report_many)
         submit_many = service.report_many
         circuit_probe = lambda: service.matcher.circuit.state  # noqa: E731
+        degraded_probe = service.matcher.open_domains
 
     state = None
     if args.state_file:
@@ -429,20 +482,24 @@ def main(argv=None):
         state = StateStore(args.state_file, interval_s=args.state_interval)
 
     tee = None
+    datastore = None
     if args.datastore:
         from ..datastore import LocalDatastore
         datastore = LocalDatastore(args.datastore)
         max_deltas = args.datastore_max_deltas
         max_bytes = args.datastore_max_delta_bytes
 
-        def tee(_tile, segments,
+        def tee(_tile, segments, ingest_key=None,
                 _ds=datastore, _n=max_deltas, _b=max_bytes):
             # automatic compaction policy rides the ingest: only the
             # partitions THIS flush touched are pressure-checked, so a
             # city-scale store never pays a full-store sweep per flush
-            # (datastore/store.py ingest)
+            # (datastore/store.py ingest). ingest_key is the flush
+            # identity the anonymiser stamps — the exactly-once ledger
+            # key that makes crash-replayed flushes idempotent
             return _ds.ingest_segments(segments, max_deltas=_n,
-                                       max_delta_bytes=_b)
+                                       max_delta_bytes=_b,
+                                       ingest_key=ingest_key)
 
     worker = StreamWorker(
         Formatter.from_config(args.formatter), submit,
@@ -455,7 +512,13 @@ def main(argv=None):
         flush_interval_s=args.flush_interval, state=state,
         uuid_filter=uuid_filter, submit_many=submit_many,
         report_flush_interval_s=args.report_flush_interval,
-        circuit_probe=circuit_probe)
+        circuit_probe=circuit_probe, degraded_probe=degraded_probe,
+        datastore=datastore)
+    if not args.reporter_url:
+        # poisoned-trace quarantine lands in THIS worker's trace spool
+        # (explicit beats the last-writer-wins module global — see
+        # StreamWorker.__init__)
+        service.matcher.quarantine_spool = worker._trace_spool
 
     # the flat-file input is opened under an ExitStack so the handle
     # closes on every exit path (drain, exception, --duration cut-off)

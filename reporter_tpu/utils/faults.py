@@ -5,9 +5,10 @@ crash (HttpClient.java:95-98, BatchingProcessor.java:20-22); this repo
 grew snapshots, dead-letter spools and retries piecemeal, but nothing
 could *prove* them — a failure you cannot reproduce is a failure you
 cannot test. This module is the proof substrate: named failpoints at the
-stage boundaries (``failpoint("native.prep")``, ``"egress.http"``,
-``"datastore.commit"``, ``"state.save"``, ``"matcher.submit"``,
-``"worker.offer"``, ``"worker.post_egress"``), armed by a spec string so
+stage boundaries (``failpoint("native.prep")``, ``"decode.dispatch"``,
+``"matcher.assemble"``, ``"egress.http"``, ``"datastore.commit"``,
+``"state.save"``, ``"matcher.submit"``, ``"worker.offer"``,
+``"worker.post_egress"``), armed by a spec string so
 a chaos run replays bit-identically, and costing ONE module-flag check
 when disabled — the hot paths carry the hooks permanently.
 
@@ -69,7 +70,8 @@ KINDS = ("error", "timeout", "partial", "crash")
 #: not listed here warns loudly: a typo'd spec must not silently run a
 #: faultless chaos scenario.
 KNOWN_SITES = frozenset({
-    "native.prep", "matcher.submit", "egress.http", "datastore.commit",
+    "native.prep", "decode.dispatch", "matcher.assemble",
+    "matcher.submit", "egress.http", "datastore.commit",
     "state.save", "worker.offer", "worker.post_egress",
 })
 

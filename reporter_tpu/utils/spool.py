@@ -1,0 +1,211 @@
+"""Bounded dead-letter spools: one write/shed/measure layer.
+
+PR 5 grew three independent dead-letter writers — the tile sink's
+``.deadletter`` flush layout, the batcher's ``.traces`` request-JSON
+spool, and (PR 7) the flight recorder's ``.flightrec`` postmortems —
+each hand-rolling its own atomic write and none of them bounded: a dead
+matcher or a dead sink fills the disk at stream rate, and the first
+symptom is the *disk* alarm, not a reporter one. This module is the one
+enforcement point:
+
+- :func:`write` commits a spool entry via the fsio atomic protocol
+  (these files replay later — a torn body replays as silent truncation)
+  and then enforces the byte cap.
+- ``REPORTER_TPU_DEADLETTER_MAX_MB`` caps each spool root; when a write
+  pushes a root over the cap, the OLDEST entries are shed first
+  (mtime-ordered, ties by name) and every shed file counts into
+  ``deadletter.shed`` — losing the oldest replay candidates loudly
+  beats losing the node quietly. 0 (the default) disables shedding.
+- :func:`backlog` / :func:`backlog_snapshot` measure spooled
+  file/byte totals so the worker heartbeat and /health can surface a
+  drain stall while it is still a gauge, not a full disk.
+
+The worker registers its two spool roots at startup
+(:func:`set_tile_dir` / :func:`set_trace_dir`); the matcher's
+poisoned-trace quarantine and the service's /health read them back —
+module-level like the flight recorder's dump dir, so in-process
+deployments wire themselves.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from typing import Dict, Optional
+
+from . import fsio, metrics
+
+logger = logging.getLogger("reporter_tpu.spool")
+
+#: directory names that live INSIDE a tile spool root but are not tile
+#: bodies (the trace spool, flight-recorder dumps, drainer quarantine);
+#: shedding and backlog walks of a tile root skip them — each is its
+#: own spool with its own accounting
+NESTED_SPOOLS = (".traces", ".flightrec", ".quarantine")
+
+_lock = threading.Lock()
+_tile_dir: Optional[str] = None
+_trace_dir: Optional[str] = None
+# per-root approximate spooled-byte totals, maintained by write() and
+# recalibrated to exact by enforce_cap(): the common under-cap write
+# must not pay an O(N) tree walk during the very outage that grows N.
+# Drains/sheds outside write() only make the estimate HIGH, which costs
+# one recalibrating walk — never a missed shed.
+_approx_bytes: Dict[str, int] = {}
+
+
+def cap_bytes() -> int:
+    """The per-spool-root byte cap (0 = unbounded)."""
+    from .runtime import _env_float
+    mb = _env_float("REPORTER_TPU_DEADLETTER_MAX_MB", 0.0)
+    return int(mb * 1024 * 1024) if mb > 0 else 0
+
+
+def set_tile_dir(path: Optional[str]) -> None:
+    """Register the tile dead-letter root (worker startup)."""
+    global _tile_dir
+    with _lock:
+        _tile_dir = path
+
+
+def set_trace_dir(path: Optional[str]) -> None:
+    """Register the trace-JSON dead-letter root (worker startup)."""
+    global _trace_dir
+    with _lock:
+        _trace_dir = path
+
+
+def tile_dir() -> Optional[str]:
+    with _lock:
+        return _tile_dir
+
+
+def trace_dir() -> Optional[str]:
+    with _lock:
+        return _trace_dir
+
+
+def walk_files(root: str, skip_nested: bool):
+    """Yield (path, size, mtime) for every spooled file under ``root``
+    (dot-state files skipped; nested spools skipped when asked) — the
+    ONE definition of "what counts as a spool entry"; the drainer's
+    walks and the backlog gauges share it so the skip rules cannot
+    drift apart."""
+    for dirpath, dirnames, filenames in os.walk(root):
+        if skip_nested:
+            dirnames[:] = [d for d in dirnames if d not in NESTED_SPOOLS]
+        for name in filenames:
+            if name.startswith(".") or name.endswith(".tmp"):
+                continue
+            path = os.path.join(dirpath, name)
+            try:
+                st = os.stat(path)
+            except OSError:
+                continue
+            yield path, st.st_size, st.st_mtime
+
+
+def backlog(root: Optional[str], skip_nested: bool = True) -> Dict[str, int]:
+    """{"files", "bytes"} spooled under ``root`` (0s when absent)."""
+    files = total = 0
+    if root and os.path.isdir(root):
+        for _path, size, _mtime in walk_files(root, skip_nested):
+            files += 1
+            total += size
+    return {"files": files, "bytes": total}
+
+
+#: seconds a gauge walk stays cached: /health probes and heartbeats
+#: arrive every few seconds, and a full-spool walk is O(backlog) stats
+#: at exactly the moment the node is degraded — a probe must not turn
+#: into a multi-second disk scan (or time out and mark the node dead
+#: for slowness rather than state)
+BACKLOG_TTL_S = 5.0
+_backlog_cache: Dict[str, tuple] = {}
+
+
+def backlog_cached(root: Optional[str],
+                   skip_nested: bool = True) -> Dict[str, int]:
+    """:func:`backlog` behind a :data:`BACKLOG_TTL_S` cache — the gauge
+    surface (/health, heartbeat). Gauges tolerate seconds of staleness;
+    exact callers (tests, the drainer's termination checks) use
+    :func:`backlog` directly."""
+    if not root:
+        return {"files": 0, "bytes": 0}
+    now = time.monotonic()
+    with _lock:
+        hit = _backlog_cache.get(root)
+        if hit is not None and now - hit[0] < BACKLOG_TTL_S:
+            return hit[1]
+    fresh = backlog(root, skip_nested=skip_nested)
+    with _lock:
+        _backlog_cache[root] = (now, fresh)
+    return fresh
+
+
+def backlog_snapshot() -> Dict[str, Dict[str, int]]:
+    """Backlog gauges for the registered spool roots — the /health and
+    heartbeat surface. A silently-stalled drainer shows up here as a
+    growing file count long before the disk notices."""
+    return {"tiles": backlog_cached(tile_dir()),
+            "traces": backlog_cached(trace_dir())}
+
+
+def enforce_cap(root: str, skip_nested: bool = True,
+                cap: Optional[int] = None) -> int:
+    """Shed oldest-first until ``root`` fits the cap; returns files shed."""
+    cap = cap_bytes() if cap is None else cap
+    if not cap:
+        return 0
+    entries = sorted(walk_files(root, skip_nested),
+                     key=lambda e: (e[2], e[0]))
+    total = sum(size for _p, size, _m in entries)
+    shed = 0
+    for path, size, _mtime in entries:
+        if total <= cap:
+            break
+        try:
+            os.unlink(path)
+        except OSError:
+            continue
+        total -= size
+        shed += 1
+        logger.warning("dead-letter cap: shed oldest spool entry %s "
+                       "(%d B)", path, size)
+    with _lock:
+        _approx_bytes[root] = total  # exact again after the walk
+    if shed:
+        metrics.count("deadletter.shed", shed)
+    return shed
+
+
+def write(root: str, relpath: str, payload: str,
+          skip_nested: bool = True) -> str:
+    """Atomically spool ``payload`` at ``root/relpath`` (parent dirs
+    created), then enforce the byte cap on ``root``; returns the final
+    path. Atomic because spool entries REPLAY — a torn tile body would
+    replay as a silently truncated tile, a torn trace JSON as a parse
+    error."""
+    path = os.path.join(root, relpath)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    fsio.atomic_write_text(path, payload)
+    cap = cap_bytes()
+    if cap:
+        with _lock:
+            if root not in _approx_bytes:
+                # first capped write for this root: seed the estimate
+                # from disk once (a restart may inherit a full spool)
+                _approx_bytes[root] = backlog(
+                    root, skip_nested=skip_nested)["bytes"]
+            else:
+                _approx_bytes[root] += len(payload.encode("utf-8"))
+            over = _approx_bytes[root] > cap
+        if over:
+            enforce_cap(root, skip_nested=skip_nested, cap=cap)
+    return path
+
+
+__all__ = ["write", "enforce_cap", "backlog", "backlog_cached",
+           "backlog_snapshot", "cap_bytes", "walk_files", "set_tile_dir",
+           "set_trace_dir", "tile_dir", "trace_dir", "NESTED_SPOOLS"]

@@ -271,9 +271,14 @@ class TestIngestDir:
         assert not os.path.exists(bad)
         assert os.path.exists(os.path.join(os.path.dirname(bad),
                                            ".rtpu.poison.failed"))
-        # the quarantined file is invisible to the next replay
+        # the quarantined file is invisible to the next replay — and the
+        # good file's relpath is already in the partition ledger, so the
+        # re-replay is a counted no-op instead of a double count
+        from reporter_tpu.utils import metrics
+        before = metrics.default.counter("datastore.ingest.deduped")
         again = ingest_dir(ds, str(out_dir))
-        assert again == {"files": 1, "rows": 2, "failures": 0}
+        assert again == {"files": 1, "rows": 0, "failures": 0}
+        assert metrics.default.counter("datastore.ingest.deduped") > before
 
 
 class TestDeadLetterReplay:

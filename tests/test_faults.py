@@ -326,3 +326,532 @@ class TestFailpointSites:
         assert (tmp_path / "out" / "1_2" / "0" / "1" / "f").exists()
         assert (tmp_path / "out" / ".deadletter" / "1_2" / "0" / "1"
                 / "f").exists()
+
+
+def _grid_city():
+    from reporter_tpu.synth import build_grid_city
+    return build_grid_city(rows=6, cols=6, spacing_m=200.0, seed=5,
+                           service_road_fraction=0.0,
+                           internal_fraction=0.0)
+
+
+def _reqs(city, n=4, seed=11):
+    import numpy as np
+
+    from reporter_tpu.synth import generate_trace
+    rng = np.random.default_rng(seed)
+    out = []
+    for i in range(n):
+        tr = None
+        while tr is None:
+            tr = generate_trace(city, f"fd-{i}", rng, noise_m=3.0,
+                                min_route_edges=6)
+        out.append({"uuid": tr.uuid, "trace": tr.points,
+                    "match_options": {"mode": "auto",
+                                      "report_levels": [0, 1, 2],
+                                      "transition_levels": [0, 1, 2]}})
+    return out
+
+
+def _plain(result):
+    return {"segments": [dict(s) for s in result["segments"]],
+            "mode": result["mode"]}
+
+
+class TestDecodeDomain:
+    """ISSUE 9: the decode-dispatch breaker and its numpy-oracle
+    fallback — bit-identical on the scan backend."""
+
+    @pytest.fixture(scope="class")
+    def city(self):
+        return _grid_city()
+
+    def test_decode_fallback_bit_identical(self, city):
+        from reporter_tpu.matcher import SegmentMatcher
+        m = SegmentMatcher(net=city)
+        reqs = _reqs(city)
+        want = [_plain(r) for r in m.match_many(reqs)]
+        metrics.default.reset()
+        faults.configure("decode.dispatch=error@0")
+        got = [_plain(r) for r in m.match_many(reqs)]
+        faults.clear()
+        assert got == want
+        snap = metrics.default.snapshot()["counters"]
+        assert snap["matcher.circuit.decode.errors"] > 0
+
+    def test_threshold_one_opens_then_probe_recloses(self, city,
+                                                     monkeypatch):
+        """threshold-1 + zero cooldown: ONE decode error opens the
+        breaker; the very next chunk is the half-open probe, and its
+        success re-closes — the full state walk in one call pair."""
+        from reporter_tpu.matcher import SegmentMatcher
+        monkeypatch.setenv("REPORTER_TPU_CIRCUIT_THRESHOLD", "1")
+        monkeypatch.setenv("REPORTER_TPU_CIRCUIT_COOLDOWN_S", "0")
+        m = SegmentMatcher(net=city)
+        reqs = _reqs(city)
+        want = [_plain(r) for r in m.match_many(reqs)]
+        metrics.default.reset()
+        faults.configure("decode.dispatch=error#1")
+        got = [_plain(r) for r in m.match_many(reqs)]
+        faults.clear()
+        assert got == want
+        snap = metrics.default.snapshot()["counters"]
+        assert snap["matcher.circuit.decode.opened"] == 1
+        after = [_plain(r) for r in m.match_many(reqs)]
+        assert after == want
+        snap = metrics.default.snapshot()["counters"]
+        assert snap["matcher.circuit.decode.probes"] >= 1
+        assert snap["matcher.circuit.decode.closed"] == 1
+        assert m.circuit_decode.snapshot()["state"] == "closed"
+
+    def test_fallback_skips_padded_filler_rows(self, city):
+        """A non-pow2 chunk pads its device batch with all-SKIP filler
+        rows; the oracle fallback must stay bit-identical while only
+        decoding the real traces (degraded mode is exactly when
+        throughput is scarcest)."""
+        from reporter_tpu.matcher import SegmentMatcher
+        m = SegmentMatcher(net=city)
+        reqs = _reqs(city, n=5)  # pads past 5 rows on the device batch
+        want = [_plain(r) for r in m.match_many(reqs)]
+        faults.configure("decode.dispatch=error@0")
+        got = [_plain(r) for r in m.match_many(reqs)]
+        faults.clear()
+        assert got == want
+
+    def test_open_breaker_short_circuits_chunks(self, city, monkeypatch):
+        from reporter_tpu.matcher import SegmentMatcher
+        monkeypatch.setenv("REPORTER_TPU_CIRCUIT_THRESHOLD", "1")
+        monkeypatch.setenv("REPORTER_TPU_CIRCUIT_COOLDOWN_S", "9999")
+        m = SegmentMatcher(net=city)
+        reqs = _reqs(city)
+        want = [_plain(r) for r in m.match_many(reqs)]
+        metrics.default.reset()
+        faults.configure("decode.dispatch=error#1")
+        m.match_many(reqs)
+        faults.clear()
+        assert m.circuit_decode.snapshot()["state"] == "open"
+        assert m.open_domains() == ["decode.dispatch"]
+        got = [_plain(r) for r in m.match_many(reqs)]
+        assert got == want
+        snap = metrics.default.snapshot()["counters"]
+        assert snap["matcher.circuit.decode.fallback_chunks"] > 0
+
+
+class TestAssembleDomain:
+    """ISSUE 9: assemble degradation — scalar fallback + poisoned-trace
+    quarantine that keeps every other trace's bytes unchanged."""
+
+    @pytest.fixture(scope="class")
+    def city(self):
+        return _grid_city()
+
+    def test_poisoned_trace_quarantined_rest_unchanged(self, city,
+                                                       tmp_path):
+        from reporter_tpu.matcher import SegmentMatcher
+        m = SegmentMatcher(net=city, use_native=False)
+        reqs = _reqs(city, n=4)
+        want = [_plain(r) for r in m.match_many(reqs)]
+        metrics.default.reset()
+        m.quarantine_spool = str(tmp_path / "spool")
+        # skip=1: the SECOND trace of the chunk poisons, proving the
+        # isolation is per-trace, not per-chunk-prefix
+        faults.configure("matcher.assemble=error+1#1")
+        got = [_plain(r) for r in m.match_many(reqs)]
+        faults.clear()
+        m.quarantine_spool = None
+        snap = metrics.default.snapshot()["counters"]
+        assert snap["matcher.assemble.quarantined"] == 1
+        poisoned = [i for i, (g, w) in enumerate(zip(got, want))
+                    if g != w]
+        assert len(poisoned) == 1
+        assert got[poisoned[0]] == {"segments": [],
+                                    "mode": want[poisoned[0]]["mode"]}
+        for i, (g, w) in enumerate(zip(got, want)):
+            if i != poisoned[0]:
+                assert g == w
+        names = os.listdir(str(tmp_path / "spool"))
+        assert len(names) == 1
+        with open(tmp_path / "spool" / names[0], encoding="utf-8") as f:
+            body = json.load(f)
+        assert body["uuid"] == reqs[poisoned[0]]["uuid"]
+        assert len(body["trace"]) == len(reqs[poisoned[0]]["trace"])
+
+    def test_native_batch_failure_degrades_to_scalar(self, city):
+        from reporter_tpu import native
+        from reporter_tpu.matcher import SegmentMatcher
+        if not native.available():
+            pytest.skip("native runtime unavailable")
+        m = SegmentMatcher(net=city)
+        assert m.runtime is not None
+        reqs = _reqs(city)
+        want = [_plain(r) for r in m.match_many(reqs)]
+        metrics.default.reset()
+        # one firing: the whole-batch native assembler fails, the
+        # scalar fallback serves the chunk byte-identically
+        faults.configure("matcher.assemble=error#1")
+        got = [_plain(r) for r in m.match_many(reqs)]
+        faults.clear()
+        assert got == want
+        snap = metrics.default.snapshot()["counters"]
+        assert snap["matcher.circuit.assemble.native_errors"] == 1
+        assert "matcher.assemble.quarantined" not in snap
+
+
+class TestSpoolCap:
+    """REPORTER_TPU_DEADLETTER_MAX_MB: oldest-first shedding."""
+
+    def test_oldest_shed_first(self, tmp_path, monkeypatch):
+        import time as _time
+
+        from reporter_tpu.utils import spool
+        metrics.default.reset()
+        root = str(tmp_path / "dl")
+        # ~1.5 KB cap: two 600 B entries fit, three do not
+        monkeypatch.setenv("REPORTER_TPU_DEADLETTER_MAX_MB",
+                           str(1500 / (1024 * 1024)))
+        payload = "x" * 600
+        spool.write(root, "a/oldest", payload)
+        os.utime(os.path.join(root, "a/oldest"), (1, 1))
+        spool.write(root, "b/mid", payload)
+        os.utime(os.path.join(root, "b/mid"), (2, 2))
+        spool.write(root, "c/newest", payload)
+        assert not os.path.exists(os.path.join(root, "a/oldest"))
+        assert os.path.exists(os.path.join(root, "b/mid"))
+        assert os.path.exists(os.path.join(root, "c/newest"))
+        assert metrics.default.counter("deadletter.shed") == 1
+
+    def test_nested_spools_not_shed_or_counted(self, tmp_path,
+                                               monkeypatch):
+        from reporter_tpu.utils import spool
+        root = str(tmp_path / "dl")
+        os.makedirs(os.path.join(root, ".traces"))
+        with open(os.path.join(root, ".traces", "t.json"), "w") as f:
+            f.write("y" * 4000)
+        monkeypatch.setenv("REPORTER_TPU_DEADLETTER_MAX_MB",
+                           str(1000 / (1024 * 1024)))
+        spool.write(root, "a/tile", "x" * 100)
+        # the .traces entry neither counts toward the tile root's cap
+        # nor gets shed by it (it is its own spool)
+        assert os.path.exists(os.path.join(root, ".traces", "t.json"))
+        assert os.path.exists(os.path.join(root, "a/tile"))
+        assert spool.backlog(root) == {"files": 1, "bytes": 100}
+
+    def test_restart_inherits_preexisting_spool(self, tmp_path,
+                                                monkeypatch):
+        """The running byte estimate seeds from disk on the first
+        capped write for a root: a restarted worker inheriting a full
+        spool must shed immediately, not only after writing a whole
+        cap's worth of fresh entries."""
+        import time as _time
+
+        from reporter_tpu.utils import spool
+        metrics.default.reset()
+        root = str(tmp_path / "dl")
+        os.makedirs(os.path.join(root, "old"))
+        with open(os.path.join(root, "old", "stale"), "w") as f:
+            f.write("x" * 1400)
+        os.utime(os.path.join(root, "old", "stale"), (1, 1))
+        monkeypatch.setenv("REPORTER_TPU_DEADLETTER_MAX_MB",
+                           str(1500 / (1024 * 1024)))
+        spool.write(root, "a/fresh", "y" * 600)
+        assert not os.path.exists(os.path.join(root, "old", "stale"))
+        assert os.path.exists(os.path.join(root, "a/fresh"))
+        assert metrics.default.counter("deadletter.shed") == 1
+
+    def test_unset_cap_never_sheds(self, tmp_path):
+        from reporter_tpu.utils import spool
+        metrics.default.reset()
+        root = str(tmp_path / "dl")
+        for i in range(5):
+            spool.write(root, f"f{i}", "z" * 1000)
+        assert spool.backlog(root)["files"] == 5
+        assert metrics.default.counter("deadletter.shed") == 0
+
+
+class TestDrainer:
+    """The automated dead-letter replayer (streaming/drainer.py)."""
+
+    def _response(self):
+        return {"datastore": {"reports": [
+            {"id": 1 << 25, "next_id": 2 << 25, "t0": 1500000000,
+             "t1": 1500000030, "length": 500, "queue_length": 0}]},
+            "segment_matcher": {"segments": []}}
+
+    def _seed_trace(self, root):
+        os.makedirs(os.path.join(root, ".traces"), exist_ok=True)
+        with open(os.path.join(root, ".traces", "trace-1.u.json"),
+                  "w", encoding="utf-8") as f:
+            json.dump({"uuid": "u", "trace": [
+                {"lat": 14.6, "lon": 120.98, "time": 1500000000},
+                {"lat": 14.601, "lon": 120.981, "time": 1500000030}],
+                "match_options": {"mode": "auto", "report_levels": [0],
+                                  "transition_levels": [0]}}, f)
+
+    def test_trace_replay_forwards_and_deletes(self, tmp_path):
+        from reporter_tpu.streaming.drainer import DeadLetterDrainer
+        metrics.default.reset()
+        root = str(tmp_path / "dl")
+        self._seed_trace(root)
+        forwarded = []
+        d = DeadLetterDrainer(
+            root, submit=lambda body: self._response(),
+            forward=lambda key, seg: forwarded.append((key, seg)))
+        assert d.drain_now() == 1
+        assert d.backlog() == {"tiles": 0, "traces": 0}
+        assert len(forwarded) == 1 and forwarded[0][1].valid()
+        assert metrics.default.counter("replay.traces.ok") == 1
+
+    def test_backoff_then_quarantine(self, tmp_path):
+        from reporter_tpu.streaming.drainer import DeadLetterDrainer
+        metrics.default.reset()
+        root = str(tmp_path / "dl")
+        self._seed_trace(root)
+        now = [0.0]
+        d = DeadLetterDrainer(root, submit=lambda body: None,
+                              interval_s=10.0, max_attempts=3,
+                              base_backoff_s=5.0,
+                              clock=lambda: now[0])
+        assert d.maybe_drain() == 0          # attempt 1 fails
+        now[0] = 2.0
+        assert d.maybe_drain() == 0          # paced: no pass yet
+        assert metrics.default.counter("replay.traces.fail") == 1
+        now[0] = 10.0
+        d.maybe_drain()                      # due (backoff 5s passed)
+        assert metrics.default.counter("replay.traces.fail") == 2
+        now[0] = 20.0
+        d.maybe_drain()                      # attempt 3 -> quarantine
+        assert metrics.default.counter("replay.quarantined") == 1
+        assert d.backlog()["traces"] == 0
+        qdir = os.path.join(root, ".traces", ".quarantine")
+        assert len(os.listdir(qdir)) == 1
+
+    def test_poison_replay_loop_terminates_and_quarantines(self,
+                                                           tmp_path):
+        """A deterministically-poisoned body makes the in-process
+        matcher re-quarantine it DURING its own replay (fresh spool
+        entry, well-formed empty response). The drainer must (a) score
+        that replay as a failure (quarantine-counter delta), (b) share
+        the attempt budget across the re-spooled copies (uuid budget
+        key + the matcher's deterministic per-uuid poison name), and
+        (c) terminate drain_now via the initial-entry snapshot — the
+        exact loop that used to hang worker.drain() forever."""
+        from reporter_tpu.streaming.drainer import DeadLetterDrainer
+        metrics.default.reset()
+        root = str(tmp_path / "dl")
+        self._seed_trace(root)
+        tdir = os.path.join(root, ".traces")
+
+        def poisoned_submit(body):
+            # what SegmentMatcher._quarantine_trace does in-process
+            with open(os.path.join(tdir, "poison.u.json"), "w",
+                      encoding="utf-8") as f:
+                json.dump(body, f)
+            metrics.count("matcher.assemble.quarantined")
+            return self._response()
+
+        d = DeadLetterDrainer(root, submit=poisoned_submit,
+                              max_attempts=3)
+        assert d.drain_now() == 0      # no hang; scored as failure
+        assert d.backlog()["traces"] == 2  # original + one overwrite
+        for _ in range(10):
+            d._pass(d.clock(), ignore_backoff=True)
+        # the shared budget converged every copy into .quarantine
+        assert d.backlog()["traces"] == 0
+        qdir = os.path.join(tdir, ".quarantine")
+        assert sorted(os.listdir(qdir)) == ["poison.u.json",
+                                            "trace-1.u.json"]
+
+    def test_budget_key_survives_dotted_uuids(self, tmp_path):
+        """uuids are caller-supplied and may contain dots: two fleets'
+        'fleet7.bus12' and 'fleet9.bus12' must not share one attempt
+        budget (a rightmost-token parse collapsed them), while batcher
+        and poison spellings of the SAME uuid must."""
+        from reporter_tpu.streaming.drainer import DeadLetterDrainer
+        d = DeadLetterDrainer(str(tmp_path / "dl"))
+        troot = d.trace_root
+        key = lambda name: d._budget_key(troot, os.path.join(troot, name))  # noqa: E731
+        assert key("trace-1-000001.fleet7.bus12.json") \
+            != key("trace-1-000002.fleet9.bus12.json")
+        assert key("trace-1-000001.fleet7.bus12.json") \
+            == key("poison.fleet7.bus12.json")
+        # non-conforming names fall back to path identity
+        assert key("weird") == os.path.join(troot, "weird")
+
+    def test_paced_pass_bounded_by_max_per_pass(self, tmp_path,
+                                                monkeypatch):
+        """maybe_drain runs on the stream thread: a deep all-due
+        backlog must cost at most MAX_PER_PASS attempts per pass."""
+        from reporter_tpu.streaming import drainer as drainer_mod
+        metrics.default.reset()
+        root = str(tmp_path / "dl")
+        os.makedirs(os.path.join(root, ".traces"))
+        for i in range(5):
+            with open(os.path.join(root, ".traces", f"t{i}.u{i}.json"),
+                      "w", encoding="utf-8") as f:
+                json.dump({"uuid": f"u{i}"}, f)
+        monkeypatch.setattr(drainer_mod.DeadLetterDrainer,
+                            "MAX_PER_PASS", 2)
+        d = drainer_mod.DeadLetterDrainer(
+            root, submit=lambda body: None, interval_s=0.0)
+        d.maybe_drain()
+        assert metrics.default.counter("replay.traces.fail") == 2
+
+    def test_externally_removed_entry_drops_attempt_state(self,
+                                                          tmp_path):
+        """A spool file unlinked by another hand (cap shed, operator)
+        must not pin its attempt/backoff entries forever."""
+        from reporter_tpu.streaming.drainer import DeadLetterDrainer
+        metrics.default.reset()
+        root = str(tmp_path / "dl")
+        self._seed_trace(root)
+        d = DeadLetterDrainer(root, submit=lambda body: None,
+                              max_attempts=10)
+        d._pass(0.0, ignore_backoff=True)    # fails, attempt recorded
+        assert len(d._attempts) == 1 and len(d._due) == 1
+        os.unlink(os.path.join(root, ".traces", "trace-1.u.json"))
+        d._pass(100.0, ignore_backoff=True)  # file gone -> state pruned
+        assert d._attempts == {} and d._due == {}
+
+    def test_tile_replay_reaches_sink_and_store(self, tmp_path):
+        from reporter_tpu.core.types import Segment
+        from reporter_tpu.datastore import LocalDatastore
+        from reporter_tpu.streaming.anonymiser import TileSink
+        from reporter_tpu.streaming.drainer import DeadLetterDrainer
+        metrics.default.reset()
+        root = str(tmp_path / "dl")
+        seg = Segment(1 << 25, 2 << 25, 1500000000, 1500000030, 500, 0)
+        payload = "\n".join([Segment.column_layout(),
+                             seg.csv_row("AUTO", "t")])
+        tile_rel = "1500000000_1500003599/0/100"
+        os.makedirs(os.path.join(root, tile_rel))
+        with open(os.path.join(root, tile_rel, "t.e00000003"), "w") as f:
+            f.write(payload)
+        out = str(tmp_path / "out")
+        store = LocalDatastore(str(tmp_path / "store"))
+        d = DeadLetterDrainer(root, sink=TileSink(out), datastore=store)
+        assert d.drain_now() == 1
+        assert os.path.exists(os.path.join(out, tile_rel, "t.e00000003"))
+        assert d.backlog()["tiles"] == 0
+        assert store.stats()["rows"] == 1
+        # the replay recorded its ledger key: re-ingesting the sink
+        # tree into the same store is a pure no-op
+        from reporter_tpu.datastore import ingest_dir
+        assert ingest_dir(store, out)["rows"] == 0
+
+
+class TestIngestLedger:
+    """The manifest (source, writer, epoch, tile) dedupe ledger."""
+
+    def _obs(self):
+        import numpy as np
+
+        from reporter_tpu.datastore.schema import ObservationBatch
+        return ObservationBatch(
+            segment_id=np.array([1 << 25], dtype=np.int64),
+            next_id=np.array([2 << 25], dtype=np.int64),
+            duration_s=np.array([30.0]),
+            count=np.array([1], dtype=np.int64),
+            length_m=np.array([500], dtype=np.int64),
+            queue_m=np.array([0], dtype=np.int64),
+            min_ts=np.array([1500000000], dtype=np.int64),
+            max_ts=np.array([1500000030], dtype=np.int64))
+
+    def test_keyed_ingest_dedupes_and_survives_compaction(self,
+                                                          tmp_path):
+        from reporter_tpu.datastore import LocalDatastore
+        metrics.default.reset()
+        ds = LocalDatastore(str(tmp_path / "store"))
+        assert ds.ingest(self._obs(), ingest_key="a/b/c/t.e0") == 1
+        assert ds.ingest(self._obs(), ingest_key="a/b/c/t.e0") == 0
+        assert metrics.default.counter("datastore.ingest.deduped") == 1
+        assert ds.ingest(self._obs(), ingest_key="a/b/c/t.e1") == 1
+        ds.compact()
+        # the ledger rides the compacted manifest: old keys still dedupe
+        assert ds.ingest(self._obs(), ingest_key="a/b/c/t.e0") == 0
+        assert ds.ingest(self._obs(), ingest_key="a/b/c/t.e2") == 1
+        assert ds.stats()["rows"] == 3
+
+    def test_ledger_cap_slides_dedupe_window(self, tmp_path,
+                                             monkeypatch):
+        """REPORTER_TPU_INGEST_LEDGER_MAX bounds the per-partition
+        ledger: oldest keys age out (counted), the newest N keep
+        deduping — the manifest cannot grow one key per flush forever."""
+        from reporter_tpu.datastore import LocalDatastore
+        metrics.default.reset()
+        monkeypatch.setenv("REPORTER_TPU_INGEST_LEDGER_MAX", "2")
+        ds = LocalDatastore(str(tmp_path / "store"))
+        for epoch in range(3):
+            assert ds.ingest(self._obs(),
+                             ingest_key=f"a/b/c/t.e{epoch}") == 1
+        assert metrics.default.counter(
+            "datastore.ingest.ledger_evicted") == 1
+        # newest two keys still dedupe...
+        assert ds.ingest(self._obs(), ingest_key="a/b/c/t.e2") == 0
+        assert ds.ingest(self._obs(), ingest_key="a/b/c/t.e1") == 0
+        # ...the evicted oldest is outside the window again (documented
+        # slide: replays older than the cap rely on `ingest --delete`)
+        assert ds.ingest(self._obs(), ingest_key="a/b/c/t.e0") == 1
+
+    def test_unkeyed_ingest_never_dedupes(self, tmp_path):
+        from reporter_tpu.datastore import LocalDatastore
+        ds = LocalDatastore(str(tmp_path / "store"))
+        assert ds.ingest(self._obs()) == 1
+        assert ds.ingest(self._obs()) == 1
+        assert ds.stats()["rows"] == 2
+
+    def test_anonymiser_threads_flush_identity_to_tee(self, tmp_path):
+        import re
+
+        from reporter_tpu.core.types import Segment
+        from reporter_tpu.streaming.anonymiser import Anonymiser, TileSink
+        keys = []
+
+        def tee(_tile, segments, ingest_key=None):
+            keys.append(ingest_key)
+
+        a = Anonymiser(TileSink(str(tmp_path / "out")), privacy=1,
+                       quantisation=3600, source="src", tee=tee)
+        a.process("k", Segment(1 << 25, 2 << 25, 1500000000,
+                               1500000030, 500, 0))
+        a.punctuate()
+        assert len(keys) == 1
+        # the key IS the tile file's relpath: {t0}_{t1}/{level}/{tile}/
+        # {source}.e{epoch:08d} — what ingest_dir derives on a replay
+        assert re.fullmatch(r"\d+_\d+/\d/\d+/src\.e00000000", keys[0])
+        rel = os.path.join(str(tmp_path / "out"),
+                           keys[0].replace("/", os.sep))
+        assert os.path.exists(rel)
+
+    def test_legacy_two_arg_tee_still_works(self, tmp_path):
+        from reporter_tpu.core.types import Segment
+        from reporter_tpu.streaming.anonymiser import Anonymiser, TileSink
+        seen = []
+        a = Anonymiser(TileSink(str(tmp_path / "out")), privacy=1,
+                       quantisation=3600, source="src",
+                       tee=lambda t, segs: seen.append(len(segs)))
+        a.process("k", Segment(1 << 25, 2 << 25, 1500000000,
+                               1500000030, 500, 0))
+        a.punctuate()
+        assert seen == [1]
+
+
+class TestHealthDegradedBlock:
+    def test_open_decode_circuit_flips_health(self):
+        from reporter_tpu.matcher import SegmentMatcher
+        from reporter_tpu.service.server import ReporterService
+        service = ReporterService(SegmentMatcher(net=_grid_city()))
+        m = service.matcher
+        code, body = service.health()
+        body = json.loads(body)
+        assert code == 200
+        assert body["degraded"]["open"] == []
+        assert set(body["degraded"]["domains"]) == {
+            "native.prep", "decode.dispatch", "matcher.assemble"}
+        assert set(body["deadletter"]) == {"tiles", "traces"}
+        for _ in range(m.circuit_decode.threshold):
+            m.circuit_decode.record_failure()
+        code, body = service.health()
+        body = json.loads(body)
+        assert code == 503
+        assert body["degraded"]["open"] == ["decode.dispatch"]
+        assert body["status"] == "degraded"

@@ -10,6 +10,7 @@ from reporter_tpu.matcher.batchpad import (
 from reporter_tpu.matcher.hmm import (
     NORMAL, RESTART, SKIP, viterbi_decode_batch)
 from reporter_tpu.synth import build_grid_city, generate_trace
+from reporter_tpu.utils import metrics
 
 
 @pytest.fixture(scope="module")
@@ -325,6 +326,11 @@ class TestDevicePipeline:
         assert all(r is not None for r in piped)
 
     def test_lane_error_propagates(self, city, monkeypatch):
+        """A decode explosion no longer kills the batch — the decode
+        breaker degrades the chunk to the numpy oracle (ISSUE 9). The
+        error only propagates out of the lanes when the fallback fails
+        too (the truly-dead case the drain futures must surface)."""
+        import reporter_tpu.matcher.cpu_ref as cpu_ref
         import reporter_tpu.ops as ops
 
         def boom(*a, **kw):
@@ -332,8 +338,14 @@ class TestDevicePipeline:
 
         monkeypatch.setattr(ops, "decode_batch", boom)
         m = SegmentMatcher(net=city)
+        got = m.match_many(self._reqs(city, n=4))
+        assert all(r and r["segments"] for r in got)
+        assert metrics.default.counter("matcher.circuit.decode.errors") > 0
+
+        monkeypatch.setattr(cpu_ref, "viterbi_decode_numpy", boom)
+        m2 = SegmentMatcher(net=city)
         with pytest.raises(RuntimeError, match="decode exploded"):
-            m.match_many(self._reqs(city, n=4))
+            m2.match_many(self._reqs(city, n=4))
 
     def test_prep_failure_quiesces_lanes(self, city, monkeypatch):
         """A malformed trace mid-dispatch must raise AND leave the shared

@@ -410,11 +410,380 @@ def scenario_egress_outage() -> int:
         return 0
 
 
+# ---------------------------------------------------------------------------
+def scenario_decode_poison() -> int:
+    """Decode + assemble failure domains (ISSUE 9): a decode storm opens
+    the decode breaker and every chunk is served via the numpy oracle
+    byte-identically until a probe re-closes it; then a poisoned
+    assemble quarantines exactly ONE trace to the dead-letter spool
+    while every other trace's bytes stay identical."""
+    from reporter_tpu.matcher import SegmentMatcher
+    from reporter_tpu.utils import faults, metrics
+
+    os.environ["REPORTER_TPU_CIRCUIT_THRESHOLD"] = "3"
+    os.environ["REPORTER_TPU_CIRCUIT_COOLDOWN_S"] = "3.0"
+    try:
+        import numpy as np
+        from reporter_tpu.synth import generate_trace
+        city = _city()
+        matcher = SegmentMatcher(net=city)
+        rng = np.random.default_rng(12)
+        reqs = []
+        for i in range(8):
+            tr = None
+            while tr is None:
+                tr = generate_trace(city, f"poison-{i}", rng, noise_m=3.0,
+                                    min_route_edges=8)
+            reqs.append({"uuid": tr.uuid, "trace": tr.points,
+                         "match_options": {"mode": "auto",
+                                           "report_levels": [0, 1, 2],
+                                           "transition_levels": [0, 1, 2]}})
+        want = [_as_plain(r) for r in matcher.match_many(reqs)]
+        metrics.default.reset()
+
+        # part 1: decode storm — every device dispatch errors until the
+        # decode breaker trips; chunks serve via the per-trace oracle
+        faults.configure("decode.dispatch=error@0")
+        stormed = []
+        for _ in range(5):
+            stormed.append([_as_plain(r) for r in matcher.match_many(reqs)])
+        snap = metrics.default.snapshot()["counters"]
+        if matcher.circuit_decode.snapshot()["state"] not in ("open",
+                                                             "half_open"):
+            return fail(f"decode circuit did not open: "
+                        f"{matcher.circuit_decode.snapshot()}")
+        if not snap.get("matcher.circuit.decode.opened"):
+            return fail(f"no decode open transition counted: {snap}")
+        if not snap.get("matcher.circuit.decode.fallback_chunks"):
+            return fail(f"no chunk was short-circuited to the oracle: "
+                        f"{snap}")
+        for got in stormed:
+            if got != want:
+                return fail("oracle-decoded results diverged from the "
+                            "fault-free device run")
+        log(f"decode_poison: decode circuit opened after "
+            f"{snap.get('matcher.circuit.decode.errors', 0)} errors, "
+            f"{snap.get('matcher.circuit.decode.fallback_chunks')} "
+            f"chunks decoded by the oracle, results byte-identical")
+
+        faults.clear()
+        time.sleep(3.2)
+        after = [_as_plain(r) for r in matcher.match_many(reqs)]
+        snap = metrics.default.snapshot()["counters"]
+        if matcher.circuit_decode.snapshot()["state"] != "closed":
+            return fail(f"decode circuit did not re-close: "
+                        f"{matcher.circuit_decode.snapshot()}")
+        if not snap.get("matcher.circuit.decode.probes") \
+                or not snap.get("matcher.circuit.decode.closed"):
+            return fail(f"no decode probe/close recorded: {snap}")
+        if after != want:
+            return fail("post-recovery decode results diverged")
+        log("decode_poison: probe re-closed the decode circuit")
+
+        # part 2: poisoned assemble — on the native path the first
+        # eligible call is the whole-batch assembler (breaker failure ->
+        # scalar fallback), so one more firing poisons exactly one
+        # trace; pure-numpy paths go straight to the scalar loop
+        metrics.default.reset()
+        with tempfile.TemporaryDirectory() as spool:
+            matcher.quarantine_spool = spool
+            limit = 2 if matcher.runtime is not None else 1
+            faults.configure(f"matcher.assemble=error@0#{limit}")
+            try:
+                got = [_as_plain(r) for r in matcher.match_many(reqs)]
+            finally:
+                faults.clear()
+                matcher.quarantine_spool = None
+            snap = metrics.default.snapshot()["counters"]
+            if snap.get("matcher.assemble.quarantined") != 1:
+                return fail(f"expected exactly 1 quarantined trace: "
+                            f"{snap}")
+            poisoned = [i for i, (g, w) in enumerate(zip(got, want))
+                        if g != w]
+            if len(poisoned) != 1:
+                return fail(f"poison leaked past one trace: {poisoned}")
+            if got[poisoned[0]]["segments"]:
+                return fail("poisoned trace did not degrade to an "
+                            "empty match")
+            names = sorted(os.listdir(spool))
+            if len(names) != 1:
+                return fail(f"expected 1 spooled poison body: {names}")
+            with open(os.path.join(spool, names[0]),
+                      encoding="utf-8") as f:
+                body = json.load(f)
+            if body.get("uuid") != reqs[poisoned[0]]["uuid"] \
+                    or not body.get("trace"):
+                return fail(f"unreplayable poison body: "
+                            f"{str(body)[:200]}")
+        log(f"decode_poison ok: 1 trace quarantined "
+            f"({reqs[poisoned[0]]['uuid']}), other {len(reqs) - 1} "
+            f"traces byte-identical")
+        return 0
+    finally:
+        faults.clear()
+        os.environ.pop("REPORTER_TPU_CIRCUIT_THRESHOLD", None)
+        os.environ.pop("REPORTER_TPU_CIRCUIT_COOLDOWN_S", None)
+
+
+# ---------------------------------------------------------------------------
+def _store_fingerprint(root: str) -> dict:
+    """{relpath: bytes} of a datastore tree — the byte-parity comparand
+    (meta.json excluded: it carries a wall-clock 'created' stamp)."""
+    out = {}
+    for dirpath, _dirnames, filenames in os.walk(root):
+        for name in sorted(filenames):
+            if name == "meta.json":
+                continue
+            path = os.path.join(dirpath, name)
+            with open(path, "rb") as f:
+                out[os.path.relpath(path, root)] = f.read()
+    return out
+
+
+def scenario_double_ingest() -> int:
+    """The (epoch, tile) dedupe ledger: an `ingest --delete` re-run of an
+    already-ingested directory appends NOTHING (store byte-identical),
+    and a worker crash-replayed between tee ingest and epoch commit
+    re-offers every flush — deduped, store equal to a fault-free run."""
+    from reporter_tpu.datastore import LocalDatastore, ingest_dir
+    from reporter_tpu.utils import faults as faults_mod
+    from reporter_tpu.utils import metrics
+
+    with tempfile.TemporaryDirectory() as tmp:
+        city = _city()
+        lines = _lines(city, n_traces=6)
+
+        # leg 1: directory replay idempotence
+        out = os.path.join(tmp, "out")
+        worker = _make_worker(city, out)
+        worker.run(iter(lines))
+        store_dir = os.path.join(tmp, "store")
+        ds = LocalDatastore(store_dir)
+        first = ingest_dir(ds, out)
+        if not first["rows"]:
+            return fail(f"first ingest empty: {first}")
+        before = _store_fingerprint(store_dir)
+        metrics.default.reset()
+        again = ingest_dir(ds, out, delete=True)
+        snap = metrics.default.snapshot()["counters"]
+        if again["rows"] != 0:
+            return fail(f"re-ingest appended rows: {again}")
+        if not snap.get("datastore.ingest.deduped"):
+            return fail(f"no dedupe counted: {snap}")
+        if _store_fingerprint(store_dir) != before:
+            return fail("re-ingest changed store bytes")
+        if _tile_tree(out):
+            return fail("--delete left tile files behind")
+        log(f"double_ingest: --delete re-run of {again['files']} files "
+            f"deduped to 0 rows, store byte-identical")
+
+        # leg 2: crash between tee ingest + egress and the epoch commit
+        # (worker.post_egress) -> restart re-emits the whole flush ->
+        # ledger dedupes the tee, sink overwrites the tiles
+        graph = os.path.join(tmp, "city.npz")
+        city.save(graph)
+        full = os.path.join(tmp, "full.txt")
+        empty = os.path.join(tmp, "empty.txt")
+        with open(full, "w") as f:
+            f.write("\n".join(lines) + "\n")
+        with open(empty, "w") as f:
+            f.write("")
+
+        def cmd(inp, out_dir, state, store):
+            return [sys.executable, "-m", "reporter_tpu", "stream",
+                    "-f", FMT, "--graph", graph, "-p", "1", "-q", "3600",
+                    "-i", "1000000000", "-s", "chaos", "-o", out_dir,
+                    "--input", inp, "--state-file", state,
+                    "--state-interval", "0", "--uuid-filter", "off",
+                    "-r", "0,1,2", "-x", "0,1,2",
+                    "--datastore", store,
+                    "--report-flush-interval", "1000000000"]
+
+        env = dict(os.environ, REPORTER_TPU_PLATFORM="cpu")
+        env.pop("REPORTER_TPU_FAULTS", None)
+
+        out_ref = os.path.join(tmp, "ref_out")
+        store_ref = os.path.join(tmp, "ref_store")
+        p = subprocess.run(
+            cmd(full, out_ref, os.path.join(tmp, "s_ref"), store_ref),
+            env=env, cwd=REPO, capture_output=True, text=True,
+            timeout=600)
+        if p.returncode != 0:
+            return fail(f"fault-free run rc={p.returncode}: "
+                        f"{p.stderr[-2000:]}")
+
+        out_chaos = os.path.join(tmp, "chaos_out")
+        store_chaos = os.path.join(tmp, "chaos_store")
+        state = os.path.join(tmp, "s_chaos")
+        env_crash = dict(env,
+                         REPORTER_TPU_FAULTS="worker.post_egress=crash#1")
+        p = subprocess.run(cmd(full, out_chaos, state, store_chaos),
+                           env=env_crash, cwd=REPO, capture_output=True,
+                           text=True, timeout=600)
+        if p.returncode != faults_mod.CRASH_EXIT_CODE:
+            return fail(f"crash run rc={p.returncode} "
+                        f"(want {faults_mod.CRASH_EXIT_CODE}): "
+                        f"{p.stderr[-2000:]}")
+        # restart over an EMPTY stream: everything re-emitted comes from
+        # the restored snapshot — the pure crash-replay window
+        p = subprocess.run(cmd(empty, out_chaos, state, store_chaos),
+                           env=env, cwd=REPO, capture_output=True,
+                           text=True, timeout=600)
+        if p.returncode != 0:
+            return fail(f"restore run rc={p.returncode}: "
+                        f"{p.stderr[-2000:]}")
+        if "dedupe" not in p.stderr:
+            return fail("restore run logged no ledger dedupe — the tee "
+                        "replay was not deduplicated")
+
+        ref_t, got_t = _tile_tree(out_ref), _tile_tree(out_chaos)
+        if not ref_t or got_t != ref_t:
+            return fail(f"tile trees diverge across crash-replay: "
+                        f"ref={len(ref_t)} got={len(got_t)}")
+        s_ref = LocalDatastore(store_ref).stats()
+        s_got = LocalDatastore(store_chaos).stats()
+        for key in ("rows", "cells", "transitions"):
+            if s_ref[key] != s_got[key]:
+                return fail(f"crash-replayed store diverges on {key}: "
+                            f"{s_got[key]} != {s_ref[key]}")
+        log(f"double_ingest ok: crash-replayed tee deduped "
+            f"({s_got['rows']} rows, {len(got_t)} tile files "
+            f"byte-identical to fault-free)")
+        return 0
+
+
+# ---------------------------------------------------------------------------
+def scenario_replay_drain() -> int:
+    """The automated dead-letter replayer: a full matcher + sink outage
+    spools every trace and tile; once the outage clears, the drainer
+    empties both spools (re-submitting traces through the live pipeline,
+    re-egressing tiles) and the datastore ends equal to a fresh ingest
+    of the final tile tree — nothing lost, nothing duplicated."""
+    from reporter_tpu.datastore import LocalDatastore, ingest_dir
+    from reporter_tpu.utils import faults, metrics
+
+    os.environ["REPORTER_TPU_REPLAY_INTERVAL_S"] = "1000000"
+    os.environ["REPORTER_TPU_REPLAY_ATTEMPTS"] = "10"
+    try:
+        with tempfile.TemporaryDirectory() as tmp:
+            from reporter_tpu.matcher import SegmentMatcher
+            from reporter_tpu.service.server import ReporterService
+            from reporter_tpu.streaming.anonymiser import (Anonymiser,
+                                                           TileSink)
+            from reporter_tpu.streaming.formatter import Formatter
+            from reporter_tpu.streaming.worker import (StreamWorker,
+                                                       inproc_submitter)
+
+            city = _city()
+            lines = _lines(city, n_traces=6)
+            metrics.default.reset()
+            out = os.path.join(tmp, "out")
+            store = LocalDatastore(os.path.join(tmp, "store"))
+
+            def tee(_tile, segments, ingest_key=None):
+                return store.ingest_segments(segments,
+                                             ingest_key=ingest_key)
+
+            service = ReporterService(SegmentMatcher(net=city),
+                                      threshold_sec=15, max_batch=64,
+                                      max_wait_ms=5.0)
+            worker = StreamWorker(
+                Formatter.from_config(FMT), inproc_submitter(service),
+                Anonymiser(TileSink(out), privacy=1, quantisation=3600,
+                           source="chaos", tee=tee),
+                reports="0,1,2", transitions="0,1,2",
+                flush_interval_s=1e9, submit_many=service.report_many,
+                report_flush_interval_s=0.0, datastore=store)
+            if worker.drainer is None:
+                return fail("drainer did not arm")
+
+            # phase 1 — total matcher outage: every submit (live and
+            # drainer replay alike — same failpoint) fails, so every
+            # qualifying trace dead-letters; nothing reports, so no
+            # tiles exist yet
+            faults.configure("matcher.submit=error@0,egress.http=error@0")
+            try:
+                worker.run(iter(lines))
+            finally:
+                faults.clear()
+            snap = metrics.default.snapshot()["counters"]
+            if not snap.get("batch.deadletter"):
+                return fail(f"outage spooled no traces: {snap}")
+            backlog = worker.drainer.backlog()
+            if not backlog["traces"]:
+                return fail(f"trace spool empty before drain: {backlog}")
+            log(f"replay_drain: matcher outage spooled "
+                f"{backlog['traces']} trace(s)")
+
+            # phase 2 — matcher back, sink still down: the drainer
+            # re-submits every spooled trace through the live pipeline;
+            # their recovered segments flush to tiles, which fail egress
+            # and seed the TILE spool
+            faults.configure("egress.http=error@0")
+            try:
+                worker.drain()
+            finally:
+                faults.clear()
+            snap = metrics.default.snapshot()["counters"]
+            if not snap.get("replay.traces.ok"):
+                return fail(f"drainer re-submitted no traces: {snap}")
+            if not snap.get("egress.deadletter"):
+                return fail(f"recovered flush spooled no tiles: {snap}")
+            backlog = worker.drainer.backlog()
+            if backlog["traces"]:
+                return fail(f"trace spool not drained: {backlog}")
+            if not backlog["tiles"]:
+                return fail(f"tile spool empty before drain: {backlog}")
+            log(f"replay_drain: sink outage spooled {backlog['tiles']} "
+                f"tile(s) from the recovered flush")
+
+            # phase 3 — everything back: one drain cycle re-egresses the
+            # spooled tiles and leaves both spools empty
+            worker.drain()
+            snap = metrics.default.snapshot()["counters"]
+            backlog = worker.drainer.backlog()
+            if backlog["traces"] or backlog["tiles"]:
+                return fail(f"spools not drained: {backlog}")
+            if snap.get("replay.quarantined"):
+                return fail(f"recoverable entries were quarantined: "
+                            f"{snap}")
+            if not snap.get("replay.traces.ok") \
+                    or not snap.get("replay.tiles.ok"):
+                return fail(f"drainer replayed nothing: {snap}")
+            tiles = _tile_tree(out)
+            if not tiles:
+                return fail("no tiles reached the sink after drain")
+
+            # store parity: the tee-fed store must equal a fresh store
+            # built from the final tile tree (end-to-end exactly-once)
+            fresh = LocalDatastore(os.path.join(tmp, "fresh"))
+            got = ingest_dir(fresh, out)
+            if got["failures"]:
+                return fail(f"tile-tree ingest failed: {got}")
+            s1, s2 = store.stats(), fresh.stats()
+            for key in ("rows", "cells", "transitions"):
+                if s1[key] != s2[key]:
+                    return fail(f"store diverges on {key}: "
+                                f"{s1[key]} != {s2[key]}")
+            log(f"replay_drain ok: {snap['replay.traces.ok']} trace(s) "
+                f"re-submitted, {snap['replay.tiles.ok']} tile(s) "
+                f"re-egressed, spools empty, store parity "
+                f"({s1['rows']} rows)")
+            return 0
+    finally:
+        faults.clear()
+        os.environ.pop("REPORTER_TPU_REPLAY_INTERVAL_S", None)
+        os.environ.pop("REPORTER_TPU_REPLAY_ATTEMPTS", None)
+
+
 SCENARIOS = {
     "storm": scenario_storm,
     "kill_restore": scenario_kill_restore,
     "submit_burst": scenario_submit_burst,
     "egress_outage": scenario_egress_outage,
+    "decode_poison": scenario_decode_poison,
+    "double_ingest": scenario_double_ingest,
+    "replay_drain": scenario_replay_drain,
 }
 
 

@@ -150,6 +150,42 @@ def gate(candidate: dict, entries: List[dict], tolerance: float,
     return (not verdict["failures"]), verdict
 
 
+def gate_bigreplay(path: str, min_ratio: float) -> Tuple[bool, dict]:
+    """Gate a tools/bigreplay.py artifact: the chaos leg's throughput
+    over the clean leg's (same process, same box — a true ratio) must
+    not fall below ``min_ratio``. This is the "robustness never
+    silently costs performance" leg: a fault-path regression (a
+    blocking drainer, an over-eager breaker, a spool fsync storm)
+    shows up as the chaos leg slowing relative to clean long before it
+    shows in clean-path medians."""
+    with open(path, encoding="utf-8") as f:
+        art = json.load(f)
+    if art.get("kind") != "bigreplay":
+        raise SystemExit(f"{path} is not a bigreplay artifact")
+    ratio = art.get("fault_throughput_ratio")
+    verdict = {
+        "candidate": {"source": os.path.basename(path),
+                      "kind": "bigreplay",
+                      "probes": art.get("probes"),
+                      "agreement": art.get("agreement")},
+        "fault_throughput_ratio": ratio,
+        "min_ratio": min_ratio,
+        "failures": [],
+    }
+    if ratio is None:
+        verdict["failures"].append(
+            {"check": "bigreplay", "reason": "artifact carries no "
+             "fault_throughput_ratio (failed run?)"})
+    elif ratio < min_ratio:
+        verdict["failures"].append(
+            {"check": "bigreplay", "candidate": ratio,
+             "floor": min_ratio,
+             "reason": f"chaos-leg throughput fell to {ratio:.2f}x the "
+             f"clean leg (floor {min_ratio}) — the robustness machinery "
+             "is taxing the hot path"})
+    return (not verdict["failures"]), verdict
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="perf_gate",
                                      description=__doc__.splitlines()[0])
@@ -160,6 +196,13 @@ def main(argv=None) -> int:
     parser.add_argument("--self-check", action="store_true",
                         help="gate the newest comparable ledger entry "
                         "against the median of the rest")
+    parser.add_argument("--bigreplay",
+                        help="bigreplay artifact: gate the chaos/clean "
+                        "throughput ratio against --min-fault-ratio")
+    parser.add_argument("--min-fault-ratio", type=float, default=0.4,
+                        help="floor for the bigreplay chaos-over-clean "
+                        "throughput ratio (default 0.4 — small smoke "
+                        "runs are noisy; raise it for full-scale runs)")
     parser.add_argument("--tolerance", type=float,
                         default=DEFAULT_TOLERANCE,
                         help="allowed relative vs_baseline drop below "
@@ -172,6 +215,16 @@ def main(argv=None) -> int:
                         help="fail instead of passing when no "
                         "comparable entries exist")
     args = parser.parse_args(argv)
+
+    if args.bigreplay:
+        passed, verdict = gate_bigreplay(args.bigreplay,
+                                         args.min_fault_ratio)
+        verdict["pass"] = passed
+        print(json.dumps(verdict, separators=(",", ":")))
+        if not passed:
+            for f in verdict["failures"]:
+                sys.stderr.write(f"perf_gate: FAIL: {f['reason']}\n")
+        return 0 if passed else 1
 
     entries = perf_ledger.load_ledger(args.ledger)
     if args.self_check:
@@ -197,7 +250,8 @@ def main(argv=None) -> int:
                                args.share_tolerance,
                                args.require_history)
     else:
-        parser.error("need --candidate FILE or --self-check")
+        parser.error("need --candidate FILE, --self-check or "
+                     "--bigreplay FILE")
         return 2  # unreachable; parser.error exits
 
     verdict["pass"] = passed

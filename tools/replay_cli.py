@@ -1,0 +1,155 @@
+#!/usr/bin/env python
+"""Standalone dead-letter replay: drain a worker's spools by hand.
+
+The streaming worker drains its own spools when
+``REPORTER_TPU_REPLAY_INTERVAL_S`` is set (streaming/drainer.py); this
+CLI drives the SAME drainer one-shot against a spool directory for
+split deployments and operators — spooled ``.traces`` bodies are
+/report-ready request JSON (re-POSTed to any matcher service), spooled
+tiles are flush-layout CSV (re-egressed to any sink the worker could
+have written: directory, http(s) endpoint, s3 bucket).
+
+A /report response is observations, and observations may only re-enter
+the world through a privacy-culling anonymiser — so trace replay
+(``--url``) builds one: recovered segments are culled, tiled and
+flushed into ``--sink`` (and teed into ``--datastore``) under this
+run's own source name (default ``replay-<pid>``, so recovered tile
+files can never collide with a live writer's epoch-named files or an
+earlier replay run's). Pass the worker's ``--privacy``/``--quantisation``
+so the recovery pipeline enforces the same contract the live one does.
+``--discard-responses`` is the explicit opt-out for the one deployment
+where dropping them is correct: the remote service owns its own
+downstream pipeline.
+
+Usage:
+  # re-POST spooled trace JSON; recovered observations re-enter through
+  # a real anonymiser into the sink (and the datastore, ledger-deduped)
+  python tools/replay_cli.py --spool OUT/.deadletter \
+      --url http://host:8002/report --privacy 5 --quantisation 3600 \
+      --sink OUT --datastore STORE
+
+  # re-egress spooled tiles only (no trace replay)
+  python tools/replay_cli.py --spool OUT/.deadletter --sink OUT
+
+Entries still failing after ``--attempts`` move to ``.quarantine``
+(skipped by every scanner) instead of wedging the drain; exit is 0 only
+when the spools it was asked to drain are empty.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("REPORTER_TPU_PLATFORM", "cpu")  # never probe a chip
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="replay_cli", description=__doc__.splitlines()[0])
+    parser.add_argument("--spool", required=True,
+                        help="tile dead-letter root (the worker's "
+                             "<output>/.deadletter); trace JSON is "
+                             "expected under its .traces subdir")
+    parser.add_argument("--url",
+                        help="matcher /report endpoint to re-POST "
+                             "spooled trace JSON to; needs --privacy/"
+                             "--quantisation/--sink (the recovery "
+                             "pipeline) or --discard-responses")
+    parser.add_argument("--sink",
+                        help="tile sink (dir / http(s) / s3) to "
+                             "re-egress spooled tiles into — and to "
+                             "flush trace-replay recoveries into")
+    parser.add_argument("--datastore",
+                        help="histogram-store dir: spooled tiles also "
+                             "replay into it (ledger-deduped, so tiles "
+                             "the worker tee already ingested no-op); "
+                             "trace-replay recoveries tee into it too")
+    parser.add_argument("--privacy", type=int,
+                        help="privacy threshold for the trace-replay "
+                             "anonymiser (use the worker's value)")
+    parser.add_argument("--quantisation", type=int,
+                        help="tile time quantisation in seconds for the "
+                             "trace-replay anonymiser (worker's value)")
+    parser.add_argument("--mode", default="auto",
+                        help="travel mode for the recovery anonymiser "
+                             "(default auto)")
+    parser.add_argument("--source", default=f"replay-{os.getpid()}",
+                        help="source name stamped into recovered tile "
+                             "files (default replay-<pid> — unique, so "
+                             "a recovery can never overwrite a live "
+                             "writer's or an earlier replay's tiles)")
+    parser.add_argument("--discard-responses", action="store_true",
+                        help="replay traces WITHOUT a local recovery "
+                             "pipeline: only correct when the remote "
+                             "service owns its own downstream pipeline "
+                             "— recovered observations are otherwise "
+                             "lost the moment the spool entry clears")
+    parser.add_argument("--attempts", type=int, default=5,
+                        help="attempts per entry before .quarantine "
+                             "(default 5)")
+    args = parser.parse_args(argv)
+    if not args.url and not args.sink and not args.datastore:
+        parser.error("nothing to do: pass --url, --sink and/or "
+                     "--datastore")
+    if args.url and not args.discard_responses and not (
+            args.privacy and args.quantisation and args.sink):
+        parser.error(
+            "--url replays observations: give them a pipeline to land "
+            "in (--privacy N --quantisation S --sink DIR, matching the "
+            "worker's knobs) or pass --discard-responses if the remote "
+            "service owns its own downstream pipeline")
+
+    from reporter_tpu.datastore import LocalDatastore
+    from reporter_tpu.streaming.anonymiser import Anonymiser, TileSink
+    from reporter_tpu.streaming.drainer import DeadLetterDrainer
+    from reporter_tpu.streaming.worker import http_submitter
+    from reporter_tpu.utils import metrics
+
+    sink = TileSink(args.sink) if args.sink else None
+    datastore = LocalDatastore(args.datastore) if args.datastore else None
+
+    recovery = None
+    if args.url and not args.discard_responses:
+        tee = None
+        if datastore is not None:
+            def tee(_tile, segments, ingest_key=None, _ds=datastore):
+                return _ds.ingest_segments(segments,
+                                           ingest_key=ingest_key)
+        recovery = Anonymiser(sink, privacy=args.privacy,
+                              quantisation=args.quantisation,
+                              mode=args.mode, source=args.source,
+                              tee=tee)
+
+    drainer = DeadLetterDrainer(
+        args.spool,
+        submit=http_submitter(args.url) if args.url else None,
+        forward=recovery.process if recovery is not None else None,
+        sink=sink,
+        datastore=datastore,
+        max_attempts=args.attempts)
+    before = drainer.backlog()
+    drained = drainer.drain_now()
+    recovered_tiles = recovery.punctuate() if recovery is not None else 0
+    after = drainer.backlog()
+    snap = metrics.default.snapshot()["counters"]
+    print(json.dumps({
+        "before": before, "drained": drained, "after": after,
+        "quarantined": snap.get("replay.quarantined", 0),
+        "traces": {"ok": snap.get("replay.traces.ok", 0),
+                   "fail": snap.get("replay.traces.fail", 0)},
+        "tiles": {"ok": snap.get("replay.tiles.ok", 0),
+                  "fail": snap.get("replay.tiles.fail", 0)},
+        "recovered_tiles": recovered_tiles,
+    }, indent=2))
+    left = (after["traces"] if args.url else 0) + \
+        (after["tiles"] if args.sink or args.datastore else 0)
+    return 0 if left == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
