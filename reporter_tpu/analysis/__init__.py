@@ -34,7 +34,7 @@ from __future__ import annotations
 from typing import Dict, List, Optional, Sequence
 
 from . import (abi, durability, fault_coverage, hotpath, jit_hygiene,
-               lockgraph, locks, registry, registry_drift)
+               lockgraph, locks, racecheck, registry, registry_drift)
 from .core import (Finding, SourceFile, collect_py_files, compare_baseline,
                    filter_suppressed, load_baseline)
 
@@ -49,7 +49,11 @@ CODE_PASSES = (hotpath, jit_hygiene, locks, lockgraph, durability)
 CONTRACT_PASSES = (registry_drift, fault_coverage)
 
 ALL_RULES: Dict[str, str] = {}
-for _p in (*CODE_PASSES, *CONTRACT_PASSES, abi):
+# racecheck's RC rules are runtime findings (the lock witness / guarded
+# audit, ISSUE 10), not a static pass — they join the catalogue so
+# --list-rules and README document one rule namespace, but no code pass
+# emits them.
+for _p in (*CODE_PASSES, *CONTRACT_PASSES, abi, racecheck):
     ALL_RULES.update(_p.RULES)
 
 
@@ -77,4 +81,4 @@ __all__ = ["Finding", "SourceFile", "collect_py_files", "load_baseline",
            "run_contract_passes", "CODE_PASSES", "CONTRACT_PASSES",
            "ALL_RULES", "abi", "hotpath", "jit_hygiene", "locks",
            "lockgraph", "durability", "registry", "registry_drift",
-           "fault_coverage"]
+           "fault_coverage", "racecheck"]

@@ -20,6 +20,16 @@ followed, and nested defs are folded into their enclosing function
 (documented approximations — both err toward missing an edge, never
 toward inventing one).
 
+Callbacks handed to ``executor.submit(fn, ...)`` and
+``Thread(target=fn)`` resolve like direct calls (the pre-ISSUE 10
+LD002/LD003 blind spot): the callback runs on another thread, but a
+caller that holds a lock at the submit site is coupled to everything
+the callback acquires — the idiom is submit-then-``result()``/
+``join()``, and even without the join the callback's acquisitions
+order against the held lock whenever the pool runs it before the
+holder releases. Lambdas and non-name callbacks are not followed
+(same err-toward-missing rule as ambiguous calls).
+
 LD002  cycle in the lock-acquisition graph: some execution order of the
        involved threads deadlocks. Reported once per cycle, at the
        acquisition site that closes it.
@@ -53,6 +63,22 @@ _BLOCKING_DOTTED = (
 )
 
 
+def _callback_name(call: ast.Call) -> Optional[str]:
+    """The terminal name of a callback handed to ``<pool>.submit(fn,
+    ...)`` or ``Thread(target=fn)`` — the call shapes that move work to
+    another thread. None for lambdas/partials/non-name callbacks (not
+    followed; errs toward missing an edge)."""
+    leaf = terminal_name(call.func)
+    if leaf == "submit" and isinstance(call.func, ast.Attribute) \
+            and call.args:
+        return terminal_name(call.args[0])
+    if leaf == "Thread":
+        for kw in call.keywords:
+            if kw.arg == "target":
+                return terminal_name(kw.value)
+    return None
+
+
 def _is_blocking(call: ast.Call) -> Optional[str]:
     leaf = terminal_name(call.func)
     if leaf is None:
@@ -68,6 +94,23 @@ def _is_blocking(call: ast.Call) -> Optional[str]:
                 return f"{suffix}()"
     return None
 
+
+#: ubiquitous stdlib protocol names (containers, files, queues,
+#: threads, futures): ``obj.append(...)``'s receiver is almost never
+#: package code, so a package function that happens to share the name
+#: (``HistogramStore.append``, ``http.put``) must not be resolved as
+#: the callee through the package-wide-unique fallback. Same-class and
+#: same-module resolution still apply — only the fallback is barred
+#: (errs toward missing an edge, like every approximation here).
+_COMMON_METHODS = frozenset({
+    "append", "appendleft", "extend", "add", "insert", "remove",
+    "discard", "pop", "popitem", "clear", "update", "setdefault",
+    "get", "put", "get_nowait", "put_nowait", "close", "open", "read",
+    "write", "flush", "seek", "join", "start", "run", "send", "recv",
+    "acquire", "release", "wait", "notify", "notify_all", "set",
+    "result", "cancel", "shutdown", "submit", "sort", "reverse",
+    "index", "copy", "items", "keys", "values",
+})
 
 LockId = Tuple[str, str, str]  # (relpath, owner, attr)
 
@@ -193,6 +236,15 @@ class _Collector(ast.NodeVisitor):
                     info.held_blocking.append((held, line, desc))
                 elif leaf is not None:
                     info.held_calls.append((held, line, leaf))
+            # executor.submit / Thread(target=...) callbacks: resolve
+            # the handed function like a direct call, so locks it
+            # acquires (and blocking work it does) are no longer
+            # invisible to the graph just because a pool runs them
+            cb = _callback_name(node)
+            if cb is not None:
+                info.all_calls.add(cb)
+                for held, line in self._held:
+                    info.held_calls.append((held, line, cb))
         self.generic_visit(node)
 
 
@@ -218,6 +270,8 @@ class _Resolver:
         got = self.by_file[caller.relpath].get(name)
         if got is not None:
             return got
+        if name in _COMMON_METHODS:
+            return None  # stdlib protocol name: receiver is foreign
         everywhere = self.by_name.get(name, [])
         if len(everywhere) == 1:
             return everywhere[0]

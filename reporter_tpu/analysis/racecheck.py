@@ -1,0 +1,316 @@
+"""Runtime concurrency witness (RC rules): the dynamic half of the lock
+discipline the static passes (LD001-LD003) pin syntactically.
+
+Armed through :mod:`reporter_tpu.utils.locks` (``REPORTER_TPU_LOCKCHECK``),
+every :class:`~reporter_tpu.utils.locks.TrackedLock` acquire/release and
+every Guarded/thread_affine access reports here. The witness maintains a
+per-thread held-lock stack and a process-wide *held-before graph* (edge
+A -> B when some thread acquired B while holding A, with both
+acquisition stacks), and turns what it sees into the same
+``path:line: RULE-ID message`` findings the static suite renders —
+against the same always-empty baseline.
+
+RC001  runtime lock-order inversion: acquiring B while holding A closed
+       a cycle in the held-before graph — some schedule of the involved
+       threads deadlocks. Reported once per cycle with both acquisition
+       stacks (the edge observed now, and the reverse path's origin).
+RC002  long hold: a lock was held longer than the
+       ``REPORTER_TPU_LOCKCHECK_HOLD_MS`` threshold — the dynamic
+       LD003 analogue (a blocking call under a lock shows up as exactly
+       this). Locks constructed ``long_hold_ok=True`` (the native
+       once-only build lock) are exempt by design.
+RC003  guarded shared state accessed without its owning lock held by
+       the accessing thread (:class:`~reporter_tpu.utils.locks.Guarded`).
+RC004  thread-affine state touched from a foreign thread
+       (:func:`~reporter_tpu.utils.locks.thread_affine`).
+
+Every new finding counts into ``racecheck.*`` metrics and leaves a
+flight-recorder postmortem (``racecheck.<rule>``), so a finding in a
+long soak is diagnosable after the fact. The witness's own bookkeeping
+runs under a *bare* lock and a thread-local re-entrancy guard: the
+locks it takes while recording must never feed back into the graph.
+
+Findings are read by ``tests/conftest.py`` (the witness-armed CI leg
+fails the pytest session on any finding) and by ``tools/racefuzz.py``
+(any finding fails the fuzz run and prints the replay seed).
+"""
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import Finding
+
+RULES = {
+    "RC001": "runtime lock-order inversion (held-before cycle)",
+    "RC002": "lock held past the long-hold threshold (dynamic LD003)",
+    "RC003": "guarded shared state accessed without its owning lock",
+    "RC004": "thread-affine state touched from a foreign thread",
+}
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+#: frames belonging to the instrumentation itself, skipped when
+#: attributing an event to a call site
+_SELF_FILES = (
+    os.path.join("reporter_tpu", "utils", "locks.py"),
+    os.path.join("reporter_tpu", "analysis", "racecheck.py"),
+)
+
+_enabled = False
+_hold_ns = 200 * 1_000_000
+
+_tls = threading.local()   # .held: List[_HeldRec]; .busy: bool
+
+#: witness internals run under a BARE lock (a TrackedLock here would
+#: re-enter the witness) — lint: the module is instrumentation, not a
+#: product-code lock site
+_graph_lock = threading.Lock()
+
+# (held_name, acquired_name) -> (site, stack, thread_name)
+_edges: Dict[Tuple[str, str], Tuple[str, str, str]] = {}
+_adj: Dict[str, Set[str]] = {}
+_findings: List[Finding] = []
+_reported: Set[Tuple] = set()
+
+
+class _HeldRec:
+    __slots__ = ("lock", "t0_ns", "site", "stack")
+
+    def __init__(self, lock, t0_ns: int, site: Tuple[str, int],
+                 stack: str):
+        self.lock = lock
+        self.t0_ns = t0_ns
+        self.site = site
+        self.stack = stack
+
+
+def enable(hold_ms: float) -> None:
+    global _enabled, _hold_ns
+    _hold_ns = int(max(0.0, hold_ms) * 1e6)
+    _enabled = True
+
+
+def disable() -> None:
+    global _enabled
+    _enabled = False
+
+
+def _call_site(skip_self: bool = True) -> Tuple[Tuple[str, int], str]:
+    """((relpath, line), short stack chain) of the nearest caller frame
+    outside the instrumentation, repo-relative. The chain keeps up to 4
+    repo frames — the "both stack traces" a deadlock report needs
+    without dumping whole tracebacks into one message line."""
+    frames: List[Tuple[str, int]] = []
+    f = sys._getframe(2)
+    while f is not None and len(frames) < 4:
+        fn = f.f_code.co_filename
+        if skip_self and any(fn.endswith(s) for s in _SELF_FILES):
+            f = f.f_back
+            continue
+        if fn.startswith(_REPO_ROOT):
+            rel = os.path.relpath(fn, _REPO_ROOT).replace(os.sep, "/")
+            frames.append((rel, f.f_lineno))
+        f = f.f_back
+    if not frames:
+        return ("<external>", 0), "<external>"
+    chain = " <- ".join(f"{p}:{ln}" for p, ln in frames)
+    return frames[0], chain
+
+
+def _record(finding: Finding, dedupe_key: Tuple) -> None:
+    """Register one finding (deduped), count it, and leave a
+    flight-recorder postmortem. Callers hold the busy guard, so the
+    metrics/flightrec locks taken here stay invisible to the graph.
+
+    The side effects re-acquire the metrics-registry and flightrec
+    locks — non-reentrant. An RC001 can fire while the reporting thread
+    still HOLDS one of them (acquiring the metrics lock under lock A is
+    itself the edge that closes a cycle), so each side effect is
+    skipped when its lock is on this thread's held stack: the finding
+    itself (the contract — render()/conftest gate/fuzz harness) is
+    always recorded."""
+    with _graph_lock:
+        if dedupe_key in _reported:
+            return
+        _reported.add(dedupe_key)
+        _findings.append(finding)
+    held_names = {h.lock.name for h in getattr(_tls, "held", ())}
+    if "metrics.registry" not in held_names:
+        from ..utils import metrics  # lazy: metrics imports locks at import
+        metrics.count("racecheck.findings")
+        metrics.count(f"racecheck.{finding.rule}")
+    if "flightrec" not in held_names:
+        from ..obs import flightrec
+        flightrec.dump(f"racecheck.{finding.rule}",
+                       {"finding": finding.render()})
+
+
+# ---- lock witness (TrackedLock hooks) --------------------------------------
+
+def note_acquired(lock) -> None:
+    if not _enabled or getattr(_tls, "busy", False):
+        return
+    _tls.busy = True
+    try:
+        held = getattr(_tls, "held", None)
+        if held is None:
+            held = _tls.held = []
+        site, stack = _call_site()
+        rec = _HeldRec(lock, time.perf_counter_ns(), site, stack)
+        for h in held:
+            if h.lock.name != lock.name:
+                _note_edge(h, rec)
+        held.append(rec)
+    finally:
+        _tls.busy = False
+
+
+def note_released(lock) -> None:
+    if not _enabled or getattr(_tls, "busy", False):
+        return
+    _tls.busy = True
+    try:
+        held = getattr(_tls, "held", None)
+        if not held:
+            return
+        rec = None
+        for i in range(len(held) - 1, -1, -1):
+            if held[i].lock is lock:
+                rec = held.pop(i)
+                break
+        if rec is None:
+            return  # acquired while busy/pre-arm: nothing to match
+        dur_ns = time.perf_counter_ns() - rec.t0_ns
+        if dur_ns >= _hold_ns and not lock.long_hold_ok:
+            path, line = rec.site
+            _record(Finding(
+                path, line, "RC002",
+                f"lock {lock.name} held {dur_ns / 1e6:.0f} ms "
+                f"(threshold {_hold_ns / 1e6:.0f} ms) — acquired here "
+                f"({rec.stack}); a blocking call under a lock stalls "
+                "every waiter"), ("RC002", lock.name, path, line))
+    finally:
+        _tls.busy = False
+
+
+def _note_edge(held: _HeldRec, new: _HeldRec) -> None:
+    """Record held.lock -> new.lock; report RC001 when it closes a
+    cycle. Runs inside the busy guard."""
+    a, b = held.lock.name, new.lock.name
+    with _graph_lock:
+        first = (a, b) not in _edges
+        if first:
+            _edges[(a, b)] = (f"{new.site[0]}:{new.site[1]}", new.stack,
+                              threading.current_thread().name)
+            _adj.setdefault(a, set()).add(b)
+        if not first:
+            return
+        path = _find_path(b, a)
+    if path is None:
+        return
+    cycle = [a] + path  # a -> b -> ... -> a
+    key = ("RC001", frozenset(cycle))
+    with _graph_lock:
+        rev_site, rev_stack, rev_thread = _edges.get(
+            (path[-2] if len(path) >= 2 else b, a),
+            ("?", "?", "?"))
+    order = " -> ".join(cycle)
+    p, line = new.site
+    _record(Finding(
+        p, line, "RC001",
+        f"runtime lock-order inversion: {a} -> {b} acquired here "
+        f"(thread {threading.current_thread().name}; {new.stack}) "
+        f"closes the cycle {order} — the reverse edge into {a} was "
+        f"observed at {rev_site} (thread {rev_thread}; {rev_stack}); "
+        "opposite-order threads deadlock"), key)
+
+
+def _find_path(src: str, dst: str) -> Optional[List[str]]:
+    """A path src -> ... -> dst in the held-before graph (caller holds
+    ``_graph_lock``); None when unreachable."""
+    stack = [(src, [src])]
+    seen = {src}
+    while stack:
+        node, path = stack.pop()
+        for nxt in sorted(_adj.get(node, ())):
+            if nxt == dst:
+                return path + [dst]
+            if nxt not in seen:
+                seen.add(nxt)
+                stack.append((nxt, path + [nxt]))
+    return None
+
+
+# ---- guarded-state audit (Guarded / thread_affine hooks) -------------------
+
+def note_guard_violation(state_name: str, lock_name: str) -> None:
+    if not _enabled or getattr(_tls, "busy", False):
+        return
+    _tls.busy = True
+    try:
+        site, stack = _call_site()
+        path, line = site
+        _record(Finding(
+            path, line, "RC003",
+            f"guarded state {state_name} accessed without holding its "
+            f"lock {lock_name} ({stack}) — every access needs the "
+            "lock"), ("RC003", state_name, path, line))
+    finally:
+        _tls.busy = False
+
+
+def note_affinity_violation(what: str) -> None:
+    if not _enabled or getattr(_tls, "busy", False):
+        return
+    _tls.busy = True
+    try:
+        site, stack = _call_site()
+        path, line = site
+        _record(Finding(
+            path, line, "RC004",
+            f"thread-affine {what} called from foreign thread "
+            f"{threading.current_thread().name} ({stack}) — this "
+            "object is single-thread-owned by design"),
+            ("RC004", what, path, line))
+    finally:
+        _tls.busy = False
+
+
+# ---- reporting -------------------------------------------------------------
+
+def findings() -> List[Finding]:
+    with _graph_lock:
+        return list(_findings)
+
+
+def render() -> List[str]:
+    """The findings as ``path:line: RC0xx message`` lines (the PR 2
+    renderer contract)."""
+    return [f.render() for f in sorted(findings())]
+
+
+def edge_count() -> int:
+    """Held-before edges observed so far (tests / harness gauges)."""
+    with _graph_lock:
+        return len(_edges)
+
+
+def reset() -> None:
+    """Drop the graph, findings and every thread's held state known to
+    have been recorded (tests; the per-thread stacks of OTHER threads
+    clear lazily as those threads release)."""
+    with _graph_lock:
+        _edges.clear()
+        _adj.clear()
+        _findings.clear()
+        _reported.clear()
+    _tls.held = []
+
+
+__all__ = ["RULES", "enable", "disable", "note_acquired", "note_released",
+           "note_guard_violation", "note_affinity_violation", "findings",
+           "render", "reset", "edge_count"]

@@ -66,6 +66,9 @@ ENV_KNOBS: Dict[str, str] = {
     "REPORTER_TPU_REPLAY_INTERVAL_S": "dead-letter drain pace (0 off)",
     "REPORTER_TPU_REPLAY_ATTEMPTS": "replays before .quarantine",
     "REPORTER_TPU_INGEST_LEDGER_MAX": "ingest-ledger keys/partition",
+    "REPORTER_TPU_LOCKCHECK": "runtime lock witness: 1 arms, raw = A/B leg",
+    "REPORTER_TPU_LOCKCHECK_HOLD_MS": "RC002 long-hold threshold (ms)",
+    "REPORTER_TPU_RACEFUZZ": "schedule-fuzz spec seed[:prob][@max_us]",
 }
 
 # ---- metric names ----------------------------------------------------------
@@ -151,6 +154,9 @@ METRICS: Dict[str, str] = {
     "decode.shadow.dropped": "shadow chunks shed (sampler backlogged)",
     "decode.shadow.errors": "shadow decode failures (chunk skipped)",
     "profile.chunks": "wide events recorded",
+    # runtime concurrency witness (analysis/racecheck.py)
+    "racecheck.findings": "witness/audit findings, all RC rules",
+    "racecheck.*": "per-rule finding counts (RC001-RC004)",
 }
 
 # ---- failpoint sites -------------------------------------------------------

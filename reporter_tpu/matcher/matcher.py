@@ -17,7 +17,6 @@ from __future__ import annotations
 import json
 import logging
 import os
-import threading
 from concurrent.futures import ThreadPoolExecutor
 from typing import List, Optional, Sequence
 
@@ -30,6 +29,7 @@ from ..graph.spatial import SpatialGrid
 from ..obs import profiler
 from ..obs import trace as obs_trace
 from ..utils import faults, metrics
+from ..utils import locks as _locks
 from ..utils.circuit import CircuitBreaker
 from .assemble import assemble_segments
 from .batchpad import (LENGTH_BUCKETS, kept_point_count, pack_batches,
@@ -338,7 +338,7 @@ class SegmentMatcher:
         # builds (losing one copy's cache warmth exactly when degraded)
         self._grid: Optional[SpatialGrid] = None
         self._route_cache: Optional[RouteCache] = None
-        self._fallback_lock = threading.Lock()
+        self._fallback_lock = _locks.new_lock("matcher.fallback")
         # C++ host runtime when available (and not explicitly disabled);
         # numpy fallback otherwise — identical contract
         self.runtime = None

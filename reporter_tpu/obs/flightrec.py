@@ -29,11 +29,11 @@ from __future__ import annotations
 import collections
 import json
 import os
-import threading
 import time
-from typing import Deque, Dict, List, Optional
+from typing import Dict, List, Optional
 
 from ..utils import fsio
+from ..utils import locks as _locks
 
 ENV_VAR = "REPORTER_TPU_FLIGHTREC"
 
@@ -44,11 +44,14 @@ ENV_VAR = "REPORTER_TPU_FLIGHTREC"
 #: a request overlapped by more than ~290 others exports best-effort)
 RING_EVENTS = 4096
 
-#: deques are append-thread-safe; only the open-span table and the
-#: dump bookkeeping need the lock
-_ring: Deque[dict] = collections.deque(maxlen=RING_EVENTS)
-_open: Dict[int, dict] = {}
-_lock = threading.Lock()
+#: ring and open-table writes AND reads hold the lock: a lone deque
+#: append is atomic, but iterating a deque while a concurrent append
+#: lands raises RuntimeError — the same race the profiler ring fixed in
+#: PR 8, audited here by the Guarded wrappers (racecheck RC003)
+_lock = _locks.new_lock("flightrec")
+_ring = _locks.Guarded(collections.deque(maxlen=RING_EVENTS), _lock,
+                       "flightrec.ring")
+_open = _locks.Guarded({}, _lock, "flightrec.open")
 _dump_dir: Optional[str] = None
 _dir_from_env = False
 _disabled = False
@@ -91,19 +94,21 @@ def span_opened(span_id: int, record: dict) -> None:
 def span_closed(span_id: int, dur_ns: int) -> None:
     with _lock:
         record = _open.pop(span_id, None)
-    if record is not None:
-        record["dur_ns"] = dur_ns
-        _ring.append(record)
+        if record is not None:
+            record["dur_ns"] = dur_ns
+            _ring.append(record)
 
 
 def record_closed(records: List[dict]) -> None:
     """Append already-closed span records (synthetic phase spans)."""
-    _ring.extend(records)
+    with _lock:
+        _ring.extend(records)
 
 
 def events() -> List[dict]:
     """Closed spans, oldest first (a snapshot copy)."""
-    return list(_ring)
+    with _lock:
+        return list(_ring)
 
 
 def in_flight() -> List[dict]:
@@ -118,7 +123,7 @@ def reset() -> None:
     """Drop ring + open table (tests)."""
     with _lock:
         _open.clear()
-    _ring.clear()
+        _ring.clear()
 
 
 # ---- the postmortem --------------------------------------------------------

@@ -53,10 +53,11 @@ import logging
 import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
-from typing import Deque, Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..utils import locks as _locks
 from ..utils import metrics
 from ..utils.runtime import _env_float, _env_int
 from . import trace as obs_trace
@@ -75,15 +76,17 @@ SHADOW_SCORE_TOL = 1e-2
 #: sampler must never become its own backlog)
 _SHADOW_MAX_PENDING = 4
 
-_lock = threading.Lock()
+_lock = _locks.new_lock("profiler")
 
 #: (B, T, K, platform) -> per-shape stats dict (see dispatch_span)
 _shapes: Dict[Tuple[int, int, int, str], dict] = {}
 
-#: the wide-event ring (deque append is thread-safe; sized once from
-#: the env at import, resizable via reset() for tests)
-_events: Deque[dict] = collections.deque(
-    maxlen=max(16, _env_int(ENV_RING, 512)))
+#: the wide-event ring; writes AND reads hold _lock (iterating a deque
+#: mid-append raises), audited by the Guarded wrapper (racecheck RC003).
+#: Sized once from the env at import, resizable via reset() for tests.
+_events = _locks.Guarded(
+    collections.deque(maxlen=max(16, _env_int(ENV_RING, 512))),
+    _lock, "profiler.events")
 
 _tls = threading.local()  # .active: [compile_calls, compile_s] or None
 
@@ -475,6 +478,21 @@ def drain_shadow(timeout_s: float = 30.0) -> bool:
     return False
 
 
+def shutdown_shadow_pool(timeout_s: float = 30.0) -> bool:
+    """Drain in-flight shadow chunks, then JOIN the sampler thread —
+    the worker's shutdown-ordering contract (ISSUE 10): no oracle job
+    may outlive the spool/datastore handles the final flush is about to
+    release. A later :func:`maybe_shadow` lazily recreates the pool
+    (multi-worker processes share it). True when the drain completed."""
+    global _shadow_pool
+    drained = drain_shadow(timeout_s)
+    with _lock:
+        pool, _shadow_pool = _shadow_pool, None
+    if pool is not None:
+        pool.shutdown(wait=True)
+    return drained
+
+
 # ---- export ----------------------------------------------------------------
 
 def _shape_view(st: dict) -> dict:
@@ -538,5 +556,6 @@ def reset() -> None:
         _shadow_pending = 0
         _shadow_sampled = 0
         _shadow_mismatch = 0
-        _events = collections.deque(maxlen=max(16, _env_int(ENV_RING,
-                                                            512)))
+        _events = _locks.Guarded(
+            collections.deque(maxlen=max(16, _env_int(ENV_RING, 512))),
+            _lock, "profiler.events")

@@ -33,6 +33,7 @@ import threading
 import time
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
+from ..utils import locks as _locks
 from . import flightrec
 
 ENV_VAR = "REPORTER_TPU_TRACE"
@@ -40,7 +41,7 @@ ENV_VAR = "REPORTER_TPU_TRACE"
 _ENABLED = False   # the one flag every disarmed span site loads
 _ARMED = False     # persistent arming (env / configure)
 _FORCED = 0        # ?trace=1 requests currently in flight
-_lock = threading.Lock()
+_lock = _locks.new_lock("trace.arm")
 
 #: (trace_id, span_id) of the innermost open span in this context
 _ctx: "contextvars.ContextVar[Optional[Tuple[str, int]]]" = \

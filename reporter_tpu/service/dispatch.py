@@ -20,7 +20,13 @@ from typing import Callable, List, Optional, Sequence
 from ..core.tracebatch import TraceBatch
 from ..obs import profiler
 from ..obs import trace as obs_trace
+from ..utils import locks as _locks
 from ..utils import metrics
+
+#: queue sentinel close() enqueues AFTER the closed flag flips: every
+#: real slot precedes it, so the loop drains all in-flight work, then
+#: exits — shutdown is a drain, not an abandonment
+_STOP = object()
 
 
 class _Slot:
@@ -66,6 +72,7 @@ class BatchDispatcher:
         self._queue: "queue.Queue[_Slot]" = queue.Queue()
         self._batches = 0  # batch sequence, stamped on batch spans
         self._closed = False
+        self._stopping = False  # loop consumed the _STOP sentinel
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="match-dispatch")
         self._thread.start()
@@ -79,6 +86,7 @@ class BatchDispatcher:
         if self._closed:
             raise RuntimeError("dispatcher is closed")
         slot = _Slot(trace, columns)
+        _locks.fuzz_point("dispatch.queue.put")
         self._queue.put(slot)
         if not slot.event.wait(timeout):
             raise TimeoutError("match result not ready in time")
@@ -116,6 +124,7 @@ class BatchDispatcher:
         else:
             slots = [_Slot(tr) for tr in traces]
         for slot in slots:  # enqueue ALL before waiting on any
+            _locks.fuzz_point("dispatch.queue.put")
             self._queue.put(slot)
         n_batches = max(1, -(-len(slots) // self.max_batch))
         deadline = time.monotonic() + timeout * n_batches
@@ -137,26 +146,44 @@ class BatchDispatcher:
         return results
 
     # ---- dispatch loop ---------------------------------------------------
+    # the drain loop is single-thread-owned (the match-dispatch thread);
+    # @thread_affine turns a second thread draining the queue — exactly
+    # the bug a future pre-fork refactor could introduce — into a named
+    # racecheck RC004 finding when the witness is armed
+    @_locks.thread_affine
     def _drain_batch(self) -> List[_Slot]:
         """Block for the first trace, then collect until a flush
         condition: ``max_batch`` reached, ``max_wait`` elapsed since the
-        first trace, or the queue stayed empty for ``idle_grace``."""
-        slots = [self._queue.get()]
+        first trace, the queue stayed empty for ``idle_grace``, or the
+        close() sentinel surfaced (every slot before it still flushes)."""
+        _locks.fuzz_point("dispatch.queue.get")
+        first = self._queue.get()
+        if first is _STOP:
+            self._stopping = True
+            return []
+        slots = [first]
         t0 = time.monotonic()
         while len(slots) < self.max_batch:
             remaining = self.max_wait - (time.monotonic() - t0)
             if remaining <= 0:
                 break
             try:
-                slots.append(self._queue.get(
-                    timeout=min(remaining, self.idle_grace)))
+                _locks.fuzz_point("dispatch.queue.get")
+                got = self._queue.get(
+                    timeout=min(remaining, self.idle_grace))
             except queue.Empty:
                 break  # idle past the grace window — flush what we have
+            if got is _STOP:
+                self._stopping = True
+                break
+            slots.append(got)
         return slots
 
     def _loop(self):
-        while not self._closed:
+        while not self._stopping:
             slots = self._drain_batch()
+            if not slots:
+                continue  # woke on the close() sentinel alone
             self._batches += 1
             metrics.count("dispatch.batches")
             metrics.count("dispatch.traces", len(slots))
@@ -197,5 +224,28 @@ class BatchDispatcher:
                 for slot in slots:
                     slot.event.set()
 
-    def close(self):
-        self._closed = True
+    def close(self, timeout: float = 30.0) -> bool:
+        """Shut down by DRAINING, not abandoning: refuse new submits,
+        let the loop flush every slot already enqueued (waiters wake
+        with real results), then join the dispatch thread — the
+        shutdown-ordering contract (ISSUE 10): no dispatch thread may
+        outlive the matcher/datastore handles its batches touch. Any
+        slot that raced past the closed check after the sentinel is
+        woken with an error rather than left to hit its wait timeout.
+        Idempotent; returns True when the loop thread fully stopped."""
+        if not self._closed:
+            self._closed = True
+            self._queue.put(_STOP)
+        self._thread.join(timeout)
+        stopped = not self._thread.is_alive()
+        if stopped:
+            while True:
+                try:
+                    slot = self._queue.get_nowait()
+                except queue.Empty:
+                    break
+                if slot is _STOP:
+                    continue
+                slot.error = RuntimeError("dispatcher is closed")
+                slot.event.set()
+        return stopped

@@ -29,6 +29,7 @@ from ..obs import flightrec
 from ..obs import trace as obs_trace
 from ..utils import faults, fsio, spool
 from ..utils import http as http_egress
+from ..utils import locks as _locks
 from ..utils import metrics
 
 logger = logging.getLogger("reporter_tpu.streaming")
@@ -229,6 +230,12 @@ class Anonymiser:
         writer = f".{self.writer_id}" if self.writer_id else ""
         return f"{self.source}{writer}.e{epoch:08d}"
 
+    # the tile map (slice_of/slices) is single-thread-owned by design:
+    # the worker punctuation loop is the only writer, and the drainer
+    # forwards replayed segments on that same thread. @thread_affine
+    # turns a second thread slipping in (racecheck RC004) into a named
+    # finding instead of a silently torn slice table.
+    @_locks.thread_affine
     def process(self, key: str, segment: Segment) -> None:
         for tile in TimeQuantisedTile.tiles_for(segment, self.quantisation):
             slice_no = self.slice_of.get(tile)
@@ -241,6 +248,7 @@ class Anonymiser:
             if len(bucket) >= SLICE_SIZE:
                 self.slice_of[tile] = slice_no + 1
 
+    @_locks.thread_affine
     def punctuate(self) -> int:
         """Flush every tile: gather slices, sort, cull, store. Returns the
         number of tiles written. Every flush consumes one epoch (bumped

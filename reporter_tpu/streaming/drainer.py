@@ -112,6 +112,20 @@ class DeadLetterDrainer:
         # through new names forever without ever reaching .quarantine
         self._attempts: Dict[str, int] = {}
         self._due: Dict[str, float] = {}
+        # worker-shutdown latch: a paused drainer's maybe_drain is a
+        # no-op, so nothing can re-enter the submit path or the sink
+        # after the worker's final flush released them (ISSUE 10
+        # shutdown-ordering contract)
+        self._paused = False
+
+    def pause(self) -> None:
+        """Stop paced drains (worker shutdown): after the final
+        drain_now, no maybe_drain may touch the submit path or sink
+        again — their handles are about to be released."""
+        self._paused = True
+
+    def resume(self) -> None:
+        self._paused = False
 
     # -- spool walks -------------------------------------------------------
     # both walks share spool.walk_files — the one definition of "what
@@ -319,6 +333,8 @@ class DeadLetterDrainer:
         directory existence checks per punctuation, and bounded to
         MAX_PER_PASS replay attempts so a deep backlog cannot stall the
         stream thread."""
+        if self._paused:
+            return 0
         now = self.clock()
         if now < self._next_pass:
             return 0

@@ -326,12 +326,23 @@ class StreamWorker:
                              epoch, e)
 
     def drain(self) -> None:
-        """End of stream: evict every open batch, give the dead-letter
-        replayer a final drain (replayed traces' segments make this last
-        flush instead of stranding in the spool), and flush all tiles."""
+        """End of stream: evict every open batch, then stop in
+        dependency order (ISSUE 10) — JOIN the shadow-accuracy pool and
+        give the dead-letter replayer a final drain + PAUSE before the
+        final flush, so no thread outlives the spool/datastore handles
+        that flush is about to release. The drain_now still runs before
+        the flush so replayed traces' segments make this last flush
+        instead of stranding in the spool."""
         self.batcher.punctuate(int(self.clock() * 1000) + 10 * self.session_gap_ms)
+        # shadow-oracle jobs read the profiler ring and count metrics;
+        # a straggler completing after the final flush would race the
+        # teardown below it. Joined here, it simply cannot.
+        profiler.shutdown_shadow_pool()
         if self.drainer is not None:
             self.drainer.drain_now()
+            # paused, not just drained: a maybe_punctuate from a late
+            # caller must not re-enter the submit path or the sink
+            self.drainer.pause()
         self._flush_tiles()
         if self.state is not None:
             self.state.save(self.batcher, self.anonymiser)

@@ -19,10 +19,10 @@ callers may all consult one instance.
 """
 from __future__ import annotations
 
-import threading
 import time
 from typing import Callable
 
+from . import locks as _locks
 from . import metrics
 
 CLOSED = "closed"
@@ -40,46 +40,52 @@ class CircuitBreaker:
         self.cooldown_s = float(cooldown_s)
         self._clock = clock
         self._registry = registry
-        self._lock = threading.Lock()
-        self._state = CLOSED
-        self._failures = 0
-        self._opened_at = 0.0
-        self._probe_inflight = False
+        self._lock = _locks.new_lock(f"circuit.{name}")
+        # the breaker's whole mutable state rides one Guarded dict: the
+        # matcher's device lanes, the dispatch loop and direct callers
+        # all consult one instance, and the runtime audit (racecheck
+        # RC003) proves every access happens under the lock
+        self._mut = _locks.Guarded(
+            {"state": CLOSED, "failures": 0, "opened_at": 0.0,
+             "probe_inflight": False}, self._lock, f"circuit.{name}")
 
     @property
     def state(self) -> str:
         with self._lock:
             # an open breaker past its cooldown is *reported* half-open:
             # the next allow() would admit a probe
-            if self._state == OPEN and \
-                    self._clock() - self._opened_at >= self.cooldown_s:
+            if self._mut["state"] == OPEN and \
+                    self._clock() - self._mut["opened_at"] \
+                    >= self.cooldown_s:
                 return HALF_OPEN
-            return self._state
+            return self._mut["state"]
 
     def allow(self) -> bool:
         """May the protected operation run right now? Open denies;
         half-open admits one probe at a time."""
         with self._lock:
-            if self._state == CLOSED:
+            st = self._mut
+            if st["state"] == CLOSED:
                 return True
-            if self._state == OPEN:
-                if self._clock() - self._opened_at < self.cooldown_s:
+            if st["state"] == OPEN:
+                if self._clock() - st["opened_at"] < self.cooldown_s:
                     return False
-                self._state = HALF_OPEN
-                self._probe_inflight = False
-            if self._probe_inflight:
+                st["state"] = HALF_OPEN
+                st["probe_inflight"] = False
+            if st["probe_inflight"]:
                 return False
-            self._probe_inflight = True
+            st["probe_inflight"] = True
         self._registry.count(f"{self.name}.probes")
         return True
 
     def record_success(self) -> None:
         closed_now = False
         with self._lock:
-            self._failures = 0
-            self._probe_inflight = False
-            if self._state != CLOSED:
-                self._state = CLOSED
+            st = self._mut
+            st["failures"] = 0
+            st["probe_inflight"] = False
+            if st["state"] != CLOSED:
+                st["state"] = CLOSED
                 closed_now = True
         if closed_now:
             self._registry.count(f"{self.name}.closed")
@@ -87,14 +93,15 @@ class CircuitBreaker:
     def record_failure(self) -> None:
         opened_now = False
         with self._lock:
-            self._probe_inflight = False
-            self._failures += 1
-            if self._state == HALF_OPEN or (
-                    self._state == CLOSED
-                    and self._failures >= self.threshold):
-                self._state = OPEN
-                self._opened_at = self._clock()
-                self._failures = 0
+            st = self._mut
+            st["probe_inflight"] = False
+            st["failures"] += 1
+            if st["state"] == HALF_OPEN or (
+                    st["state"] == CLOSED
+                    and st["failures"] >= self.threshold):
+                st["state"] = OPEN
+                st["opened_at"] = self._clock()
+                st["failures"] = 0
                 opened_now = True
         self._registry.count(f"{self.name}.failures")
         if opened_now:
@@ -108,12 +115,13 @@ class CircuitBreaker:
     def snapshot(self) -> dict:
         """State summary for /health."""
         with self._lock:
-            state = self._state
-            failures = self._failures
+            state = self._mut["state"]
+            failures = self._mut["failures"]
             remaining = 0.0
             if state == OPEN:
                 remaining = max(
-                    0.0, self.cooldown_s - (self._clock() - self._opened_at))
+                    0.0, self.cooldown_s
+                    - (self._clock() - self._mut["opened_at"]))
                 if remaining == 0.0:
                     state = HALF_OPEN
         return {"state": state, "consecutive_failures": failures,

@@ -55,8 +55,9 @@ import os
 import random
 import re
 import sys
-import threading
 from typing import Dict, Optional
+
+from . import locks as _locks
 
 logger = logging.getLogger("reporter_tpu.faults")
 
@@ -82,7 +83,7 @@ AFTER_HOOK_SITES = frozenset({"egress.http", "state.save"})
 _ENABLED = False
 _SITES: Dict[str, "_FailPoint"] = {}
 _SPEC: Optional[str] = None
-_lock = threading.Lock()
+_lock = _locks.new_lock("faults.configure")
 
 
 class FaultError(RuntimeError):
@@ -116,7 +117,7 @@ class _FailPoint:
         self.rng = random.Random(seed)
         self.fired = 0
         self.seen = 0
-        self.lock = threading.Lock()
+        self.lock = _locks.new_lock(f"faults.site.{site}")
 
     def fire(self, after: bool) -> None:
         # hook-position eligibility: partial only fires after the effect

@@ -1,0 +1,375 @@
+"""Instrumented locking: the runtime concurrency-analysis substrate.
+
+PR 2 (LD001) and PR 6 (LD002/LD003) check lock discipline *statically*;
+they are blind to locks reached through ``executor.submit`` callbacks,
+queue handoffs and the native layer, and they can only prove ordering
+the AST shows. This module is the dynamic half (ISSUE 10): every package
+lock is a :class:`TrackedLock`, and when the witness is armed
+(``REPORTER_TPU_LOCKCHECK=1``) each acquire/release feeds the runtime
+held-before graph in :mod:`reporter_tpu.analysis.racecheck`, which
+reports lock-order inversions (RC001, potential deadlock) and
+long-holds (RC002, dynamic LD003) with the acquisition stacks.
+
+Three cooperating pieces:
+
+- :class:`TrackedLock` — a named ``threading.Lock`` wrapper. Disarmed
+  cost is one module-flag load per acquire and per release (pinned by
+  ``tools/racefuzz.py --overhead``, the serialized 512-trace A/B).
+  ``REPORTER_TPU_LOCKCHECK=raw`` makes :func:`new_lock` hand out bare
+  ``threading.Lock`` objects instead — the A leg of that A/B; the
+  witness cannot arm in raw mode.
+- :class:`Guarded` / :func:`thread_affine` — the shared-state audit.
+  ``Guarded(obj, lock, name)`` proxies a mutable object and, when
+  armed, asserts the owning :class:`TrackedLock` is held by the calling
+  thread on every access (RC003). ``@thread_affine`` marks methods of
+  single-thread-owned objects (the dispatcher's drain loop, the
+  anonymiser's tile map): the first armed call binds the instance to
+  that thread, any other thread's call is RC004.
+- :func:`fuzz_point` — the schedule-perturbation layer
+  (``REPORTER_TPU_RACEFUZZ=seed[:prob][@max_us]``). Armed, each hook
+  (every lock acquire, the dispatcher's queue put/get) draws from a
+  per-site seeded RNG (``crc32(site) ^ seed`` — replayable bit-identically
+  by seed, like :mod:`.faults`) and sleeps up to ``max_us`` to shake out
+  interleavings the scheduler would rarely pick. ``tools/racefuzz.py``
+  drives scenarios under N seeds and prints the replay seed on a finding.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import random
+import threading
+import time
+import zlib
+from typing import Optional
+
+logger = logging.getLogger("reporter_tpu.locks")
+
+ENV_VAR = "REPORTER_TPU_LOCKCHECK"          # 1/on = witness armed; raw = A/B leg
+ENV_HOLD_MS = "REPORTER_TPU_LOCKCHECK_HOLD_MS"
+ENV_FUZZ = "REPORTER_TPU_RACEFUZZ"          # seed[:prob][@max_us]
+
+#: default RC002 long-hold threshold: generous enough that a loaded CI
+#: box holding the metrics lock through a GC pause stays silent, small
+#: enough that a lock held across an HTTP round trip or a subprocess
+#: does not (the dynamic LD003 analogue)
+DEFAULT_HOLD_MS = 200.0
+
+_ENABLED = False      # the one flag every disarmed lock site loads
+_RAW = False          # new_lock() hands out bare threading.Lock
+_FUZZ: Optional["_FuzzSpec"] = None
+_witness = None       # reporter_tpu.analysis.racecheck, set by arm()
+
+
+class TrackedLock:
+    """A named lock the runtime witness can observe. Same contract as
+    ``threading.Lock`` (non-reentrant; ``with`` support; ``locked()``)
+    plus a stable ``name`` — the node identity in the held-before graph
+    (instances sharing a name share a node; same-name edges are skipped,
+    so per-instance locks like the circuit breakers' do not self-cycle).
+
+    ``long_hold_ok`` exempts a documented long holder (the native
+    once-only build lock: subprocess make + ABI handshake under it is
+    the design) from RC002.
+    """
+
+    __slots__ = ("_lock", "name", "long_hold_ok", "_owner")
+
+    def __init__(self, name: str, long_hold_ok: bool = False):
+        self._lock = threading.Lock()
+        self.name = name
+        self.long_hold_ok = long_hold_ok
+        self._owner = 0  # acquiring thread id, maintained only when armed
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        if _FUZZ is not None:
+            _FUZZ.maybe_yield("lock." + self.name)
+        got = self._lock.acquire(blocking, timeout)
+        if got and _ENABLED:
+            self._owner = threading.get_ident()
+            _witness.note_acquired(self)
+        return got
+
+    def release(self) -> None:
+        if _ENABLED:
+            # clear ownership, DROP the lock, then tell the witness:
+            # note_released can emit an RC002 finding whose recording
+            # acquires the metrics/flightrec locks — if THIS lock is one
+            # of those, reporting before releasing would self-deadlock
+            # on the non-reentrant underlying lock. The duration skew
+            # from measuring after the release is nanoseconds.
+            self._owner = 0
+            self._lock.release()
+            _witness.note_released(self)
+        else:
+            self._lock.release()
+
+    def __enter__(self) -> "TrackedLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.release()
+        return False
+
+    def locked(self) -> bool:
+        return self._lock.locked()
+
+    def held_by_me(self) -> bool:
+        """Best-effort (armed-mode) check: is this lock held by the
+        calling thread? Owner tracking starts at arming, so a lock
+        acquired pre-arm reads as not-held — arm before driving."""
+        return self._lock.locked() \
+            and self._owner == threading.get_ident()
+
+
+def new_lock(name: str, long_hold_ok: bool = False):
+    """The package lock factory: a :class:`TrackedLock`, or a bare
+    ``threading.Lock`` under ``REPORTER_TPU_LOCKCHECK=raw`` (the A/B
+    baseline leg — zero wrapper overhead, zero observability)."""
+    if _RAW:
+        return threading.Lock()
+    return TrackedLock(name, long_hold_ok=long_hold_ok)
+
+
+# ---- guarded shared state --------------------------------------------------
+
+class Guarded:
+    """Audit proxy around a shared mutable (dict/deque/...): when the
+    witness is armed, every access asserts the owning lock is held by
+    the calling thread (RC003) — a silent race becomes a named finding.
+    Disarmed, each access costs one flag check plus the forward."""
+
+    __slots__ = ("_gd_obj", "_gd_lock", "_gd_name")
+
+    def __init__(self, obj, lock, name: str):
+        object.__setattr__(self, "_gd_obj", obj)
+        object.__setattr__(self, "_gd_lock", lock)
+        object.__setattr__(self, "_gd_name", name)
+
+    def _gd_check(self) -> None:
+        lock = self._gd_lock
+        if not (isinstance(lock, TrackedLock) and lock.held_by_me()):
+            _witness.note_guard_violation(self._gd_name,
+                                          getattr(lock, "name", "?"))
+
+    def unwrap(self):
+        """The raw object (tests / unguarded bulk handoff)."""
+        return self._gd_obj
+
+    def __getattr__(self, attr):
+        if _ENABLED:
+            self._gd_check()
+        return getattr(self._gd_obj, attr)
+
+    def __getitem__(self, key):
+        if _ENABLED:
+            self._gd_check()
+        return self._gd_obj[key]
+
+    def __setitem__(self, key, value):
+        if _ENABLED:
+            self._gd_check()
+        self._gd_obj[key] = value
+
+    def __delitem__(self, key):
+        if _ENABLED:
+            self._gd_check()
+        del self._gd_obj[key]
+
+    def __contains__(self, key):
+        if _ENABLED:
+            self._gd_check()
+        return key in self._gd_obj
+
+    def __iter__(self):
+        if _ENABLED:
+            self._gd_check()
+        return iter(self._gd_obj)
+
+    def __len__(self):
+        if _ENABLED:
+            self._gd_check()
+        return len(self._gd_obj)
+
+    def __bool__(self):
+        if _ENABLED:
+            self._gd_check()
+        return bool(self._gd_obj)
+
+
+_AFFINE_ATTR = "_thread_affinity_tid"
+
+
+def thread_affine(method):
+    """Mark a method of a single-thread-owned object: the first armed
+    call binds the INSTANCE to its thread; a call from any other thread
+    is an RC004 finding. All ``@thread_affine`` methods of one instance
+    share the binding (one owner thread per object). Disarmed cost is
+    one flag check per call; :func:`reset_affinity` (tests) unbinds."""
+    import functools
+
+    @functools.wraps(method)
+    def wrapper(self, *args, **kwargs):
+        if _ENABLED:
+            tid = threading.get_ident()
+            bound = getattr(self, _AFFINE_ATTR, None)
+            if bound is None:
+                try:
+                    setattr(self, _AFFINE_ATTR, tid)
+                except AttributeError:  # __slots__ class: cannot bind
+                    pass
+            elif bound != tid:
+                _witness.note_affinity_violation(
+                    f"{type(self).__name__}.{method.__name__}")
+        return method(self, *args, **kwargs)
+
+    return wrapper
+
+
+def reset_affinity(obj) -> None:
+    """Drop an instance's thread binding (tests that legitimately hand
+    an object to a fresh thread)."""
+    try:
+        delattr(obj, _AFFINE_ATTR)
+    except AttributeError:
+        pass
+
+
+# ---- arming ----------------------------------------------------------------
+
+def armed() -> bool:
+    return _ENABLED
+
+
+def arm(hold_ms: Optional[float] = None) -> None:
+    """Arm the witness + audit. ``hold_ms`` overrides the RC002
+    long-hold threshold (default ``REPORTER_TPU_LOCKCHECK_HOLD_MS``)."""
+    global _ENABLED, _witness
+    if _RAW:
+        raise RuntimeError(
+            f"{ENV_VAR}=raw hands out bare locks; the witness cannot "
+            "arm in this process")
+    from ..analysis import racecheck
+    if hold_ms is None:
+        hold_ms = _env_float(ENV_HOLD_MS, DEFAULT_HOLD_MS)
+    racecheck.enable(hold_ms)
+    _witness = racecheck
+    _ENABLED = True
+
+
+def disarm() -> None:
+    global _ENABLED
+    _ENABLED = False
+
+
+# ---- schedule perturbation -------------------------------------------------
+
+class _FuzzSpec:
+    """Seeded micro-yield injector. Per-site RNG seeded by
+    ``crc32(site) ^ seed`` so the decision/duration SEQUENCE at each
+    site replays bit-identically under the same seed (which thread gets
+    which draw still depends on the schedule — that is the point)."""
+
+    __slots__ = ("seed", "prob", "max_us", "yields", "_rngs", "_meta")
+
+    def __init__(self, seed: int, prob: float = 0.25,
+                 max_us: float = 200.0):
+        if not 0.0 < prob <= 1.0:
+            raise ValueError(f"fuzz prob {prob} out of (0,1]")
+        if max_us <= 0:
+            raise ValueError(f"fuzz max_us {max_us} must be positive")
+        self.seed = seed
+        self.prob = prob
+        self.max_us = max_us
+        self.yields = 0
+        self._rngs = {}
+        # a bare lock, deliberately: the fuzzer's own serialisation must
+        # not feed the witness or re-enter itself
+        self._meta = threading.Lock()
+
+    def maybe_yield(self, site: str) -> None:
+        # one draw sequence per site, serialised so replays by seed stay
+        # deterministic per site
+        with self._meta:
+            rng = self._rngs.get(site)
+            if rng is None:
+                rng = self._rngs[site] = random.Random(
+                    zlib.crc32(site.encode("utf-8")) ^ self.seed)
+            if rng.random() >= self.prob:
+                return
+            dur = rng.random() * self.max_us / 1e6
+            self.yields += 1
+        time.sleep(dur)
+
+
+def parse_fuzz_spec(spec: str) -> _FuzzSpec:
+    """``seed[:prob][@max_us]`` — e.g. ``7``, ``7:0.5``, ``7:0.5@400``.
+    Raises ValueError on a malformed spec (a typo'd fuzz run must not
+    silently run unperturbed)."""
+    body = spec.strip()
+    max_us = 200.0
+    if "@" in body:
+        body, us = body.split("@", 1)
+        max_us = float(us)
+    prob = 0.25
+    if ":" in body:
+        body, p = body.split(":", 1)
+        prob = float(p)
+    return _FuzzSpec(int(body), prob=prob, max_us=max_us)
+
+
+def configure_fuzz(spec: Optional[str]) -> None:
+    """(Re)arm the perturbation layer from a spec string; None/"" off."""
+    global _FUZZ
+    _FUZZ = parse_fuzz_spec(spec) if spec else None
+    if _FUZZ is not None:
+        logger.warning("schedule perturbation ARMED: seed=%d prob=%g "
+                       "max_us=%g", _FUZZ.seed, _FUZZ.prob, _FUZZ.max_us)
+
+
+def fuzz_point(site: str) -> None:
+    """A perturbation hook at a schedule-sensitive site (queue put/get;
+    lock acquires hook internally). One flag check when disarmed."""
+    f = _FUZZ
+    if f is not None:
+        f.maybe_yield(site)
+
+
+def fuzz_yields() -> int:
+    """Yields injected so far (0 when disarmed) — the fuzz harness's
+    sanity gauge that perturbation actually happened."""
+    f = _FUZZ
+    return f.yields if f is not None else 0
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        logger.error("ignoring malformed %s=%r", name, raw)
+        return default
+
+
+# arm from the environment at import: the racecheck CI stage and the
+# fuzz harness arm subprocesses by env. Malformed values must not brick
+# every import site — log loudly and stay disarmed.
+_env_val = os.environ.get(ENV_VAR, "").strip().lower()
+if _env_val == "raw":
+    _RAW = True
+elif _env_val and _env_val not in ("0", "off", "false"):
+    arm()
+_env_fuzz = os.environ.get(ENV_FUZZ)
+if _env_fuzz:
+    try:
+        configure_fuzz(_env_fuzz)
+    except ValueError as _e:  # pragma: no cover - env typo path
+        logger.error("ignoring malformed %s=%r: %s", ENV_FUZZ, _env_fuzz, _e)
+
+__all__ = ["TrackedLock", "Guarded", "new_lock", "thread_affine",
+           "reset_affinity", "arm", "disarm", "armed", "configure_fuzz",
+           "parse_fuzz_spec", "fuzz_point", "fuzz_yields",
+           "DEFAULT_HOLD_MS", "ENV_VAR", "ENV_HOLD_MS", "ENV_FUZZ"]

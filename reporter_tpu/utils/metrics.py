@@ -37,13 +37,13 @@ from __future__ import annotations
 
 import contextlib
 import math
-import threading
 import time
 from typing import Dict, Iterator, List, Tuple
 
 import numpy as np
 
 from ..obs import trace as _trace
+from . import locks as _locks
 
 #: histogram bucket upper bounds in seconds: powers of two from ~1 µs
 #: (2^-20) to 128 s (2^7). Log-spaced buckets keep relative error
@@ -111,7 +111,7 @@ class _Timer:
 
 class Registry:
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = _locks.new_lock("metrics.registry")
         self._counters: Dict[str, int] = {}
         self._timers: Dict[str, _Timer] = {}
 

@@ -30,11 +30,11 @@ from __future__ import annotations
 
 import logging
 import os
-import threading
 import time
 from typing import Dict, Optional
 
 from . import fsio, metrics
+from . import locks as _locks
 
 logger = logging.getLogger("reporter_tpu.spool")
 
@@ -44,15 +44,16 @@ logger = logging.getLogger("reporter_tpu.spool")
 #: own spool with its own accounting
 NESTED_SPOOLS = (".traces", ".flightrec", ".quarantine")
 
-_lock = threading.Lock()
+_lock = _locks.new_lock("spool")
 _tile_dir: Optional[str] = None
 _trace_dir: Optional[str] = None
 # per-root approximate spooled-byte totals, maintained by write() and
 # recalibrated to exact by enforce_cap(): the common under-cap write
 # must not pay an O(N) tree walk during the very outage that grows N.
 # Drains/sheds outside write() only make the estimate HIGH, which costs
-# one recalibrating walk — never a missed shed.
-_approx_bytes: Dict[str, int] = {}
+# one recalibrating walk — never a missed shed. Guarded: the estimate
+# is touched from every spooling thread (racecheck RC003 audit).
+_approx_bytes = _locks.Guarded({}, _lock, "spool.approx_bytes")
 
 
 def cap_bytes() -> int:
@@ -122,7 +123,7 @@ def backlog(root: Optional[str], skip_nested: bool = True) -> Dict[str, int]:
 #: into a multi-second disk scan (or time out and mark the node dead
 #: for slowness rather than state)
 BACKLOG_TTL_S = 5.0
-_backlog_cache: Dict[str, tuple] = {}
+_backlog_cache = _locks.Guarded({}, _lock, "spool.backlog_cache")
 
 
 def backlog_cached(root: Optional[str],

@@ -28,8 +28,29 @@ os.environ["REPORTER_TPU_PLATFORM"] = "cpu"
 os.environ["REPORTER_TPU_VIRTUAL_DEVICES"] = "8"
 
 import jax  # noqa: E402
+import pytest  # noqa: E402
 
 # fail loudly if the force-to-CPU mechanism ever stops working; tests must
 # never contend for the single real TPU chip (bench.py owns it)
 assert jax.default_backend() == "cpu", (
     "tests must run on the CPU backend, got " + jax.default_backend())
+
+
+@pytest.fixture(autouse=True)
+def _racecheck_gate():
+    """The witness-armed CI leg (REPORTER_TPU_LOCKCHECK=1): any RC
+    finding the runtime lock witness / guarded-state audit records
+    fails the test that surfaced it — zero findings is the contract,
+    same as the static suite's empty baseline. Disarmed runs pay one
+    flag check per test. Findings are reset after reporting so one
+    race does not cascade into every later test."""
+    yield
+    from reporter_tpu.utils import locks
+    if not locks.armed():
+        return
+    from reporter_tpu.analysis import racecheck
+    lines = racecheck.render()
+    if lines:
+        racecheck.reset()
+        pytest.fail("runtime concurrency findings:\n"
+                    + "\n".join(lines), pytrace=False)

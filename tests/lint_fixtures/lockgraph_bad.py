@@ -59,3 +59,58 @@ class Fetcher:
 
     def _do_fetch(self, url):
         return urllib.request.urlopen(url)
+
+
+class Amber:
+    """Locks reached through executor.submit / Thread(target=...): the
+    pre-ISSUE 10 blind spot — the callback runs on a pool thread, but
+    the submit-then-result()/join() idiom couples the held lock to
+    everything the callback acquires."""
+
+    def __init__(self, pool, blue):
+        self._lock = threading.Lock()
+        self.pool = pool
+        self.blue = blue
+
+    def go(self):
+        with self._lock:  # (records the Amber -> Blue edge via submit)
+            return self.pool.submit(self.blue.grab_blue).result()
+
+    def peek_amber(self):
+        with self._lock:
+            return 1
+
+
+class Blue:
+    def __init__(self, amber):
+        self._lock = threading.Lock()
+        self.amber = amber
+
+    def grab_blue(self):
+        with self._lock:
+            return 2
+
+    def back(self):
+        with self._lock:  # LD002: closes Amber->Blue->Amber (Thread target)
+            thread = threading.Thread(target=self.amber.peek_amber)
+            thread.start()
+            thread.join()
+
+
+class PoolFetcher:
+    def __init__(self, pool):
+        self._lock = threading.Lock()
+        self.pool = pool
+
+    def kick(self):
+        with self._lock:  # LD003: HTTP via a submitted callback
+            return self.pool.submit(self._work).result()
+
+    def spawn(self):
+        with self._lock:  # LD003: HTTP via a Thread target
+            thread = threading.Thread(target=self._work)
+            thread.start()
+            thread.join()
+
+    def _work(self):
+        return urllib.request.urlopen("http://example.com")

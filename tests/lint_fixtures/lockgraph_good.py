@@ -43,3 +43,23 @@ class Fetcher:
         with self._lock:
             self.cache.clear()
         return proc
+
+
+class GoodPool:
+    """Submit and join OUTSIDE the lock; the lock only guards the
+    cache — the callback's blocking work never runs under it."""
+
+    def __init__(self, pool):
+        self._lock = threading.Lock()
+        self.pool = pool
+        self.cache = {}
+
+    def kick(self, url):
+        future = self.pool.submit(self._fetch, url)
+        body = future.result()
+        with self._lock:
+            self.cache[url] = body
+        return body
+
+    def _fetch(self, url):
+        return urllib.request.urlopen(url)

@@ -1,0 +1,486 @@
+"""Runtime concurrency analysis (ISSUE 10): the lock-order witness,
+the guarded shared-state audit, and the schedule-perturbation layer.
+
+The RC rules are RUNTIME findings — unlike every other rule family they
+pin through real threads here, not through AST fixtures (test_lint.py's
+every-rule-has-a-fixture check carves them out by the same reasoning).
+Covered:
+
+- TrackedLock witness: RC001 lock-order inversion with both acquisition
+  stacks, RC002 long hold + the ``long_hold_ok`` exemption, dedupe,
+  same-name instances sharing one graph node;
+- Guarded / @thread_affine audit: RC003 without the owning lock, RC004
+  from a foreign thread, the unwrap() escape hatch, reset_affinity;
+- renderer/metrics/flightrec integration (the PR 2 ``path:line: RC0xx``
+  contract, ``racecheck.*`` counters, the postmortem dump);
+- the seeded fuzz layer: spec grammar, bit-identical replay by seed,
+  disarmed no-op;
+- the ISSUE 10 shutdown-ordering contracts: BatchDispatcher.close()
+  drains in-flight chunks on the real matcher's device lanes, and
+  StreamWorker stop joins the shadow pool + pauses the drainer before
+  the final flush.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from reporter_tpu.analysis import racecheck
+from reporter_tpu.utils import locks, metrics
+
+
+@pytest.fixture
+def witness():
+    """Arm the witness with a tight RC002 threshold; restore the
+    session's prior arming state (the witness-armed CI leg runs this
+    file armed already) and drop all findings on the way out."""
+    was_armed = locks.armed()
+    racecheck.reset()
+    locks.arm(hold_ms=100.0)
+    yield racecheck
+    racecheck.reset()
+    if was_armed:
+        locks.arm()
+    else:
+        locks.disarm()
+
+
+def _rules(found):
+    return [f.rule for f in found]
+
+
+class TestLockWitness:
+    def test_nested_acquire_records_edge(self, witness):
+        a, b = locks.TrackedLock("t.edge.a"), locks.TrackedLock("t.edge.b")
+        before = witness.edge_count()
+        with a:
+            with b:
+                pass
+        assert witness.edge_count() == before + 1
+        assert witness.findings() == []
+
+    def test_rc001_inversion_reported_with_both_stacks(self, witness):
+        a, b = locks.TrackedLock("t.inv.a"), locks.TrackedLock("t.inv.b")
+        with a:
+            with b:
+                pass
+
+        def reversed_order():
+            with b:
+                with a:
+                    pass
+
+        t = threading.Thread(target=reversed_order, name="inverter")
+        t.start()
+        t.join()
+        # recording the finding fsyncs a flightrec dump UNDER the held
+        # locks — on a loaded box that can trip the tight 100 ms RC002
+        # threshold, so pin the RC001 leg, not the exact list
+        found = [f for f in witness.findings() if f.rule == "RC001"]
+        assert _rules(found) == ["RC001"]
+        msg = found[0].render()
+        # the cycle, the closing thread, and BOTH acquisition sites
+        assert "t.inv.b -> t.inv.a" in msg or "t.inv.a -> t.inv.b" in msg
+        assert "inverter" in msg
+        assert msg.count("tests/test_racecheck.py") >= 2
+
+    def test_rc001_deduped_per_cycle(self, witness):
+        a, b = locks.TrackedLock("t.dup.a"), locks.TrackedLock("t.dup.b")
+        with a:
+            with b:
+                pass
+
+        def reversed_order():
+            with b:
+                with a:
+                    pass
+
+        for _ in range(3):
+            t = threading.Thread(target=reversed_order)
+            t.start()
+            t.join()
+        assert _rules(witness.findings()).count("RC001") == 1
+
+    def test_same_name_instances_share_a_node(self, witness):
+        # per-instance locks sharing one name (the circuit breakers'
+        # pattern) must not self-cycle: same-name edges are skipped
+        a1 = locks.TrackedLock("t.same")
+        a2 = locks.TrackedLock("t.same")
+        with a1:
+            with a2:
+                pass
+        with a2:
+            with a1:
+                pass
+        assert witness.findings() == []
+        assert witness.edge_count() == 0
+
+    def test_rc002_long_hold(self, witness):
+        lk = locks.TrackedLock("t.hold")
+        with lk:
+            time.sleep(0.15)  # threshold armed at 100 ms
+        found = witness.findings()
+        assert _rules(found) == ["RC002"]
+        assert "t.hold" in found[0].render()
+
+    def test_rc002_exempts_long_hold_ok(self, witness):
+        lk = locks.TrackedLock("t.hold.ok", long_hold_ok=True)
+        with lk:
+            time.sleep(0.15)
+        assert witness.findings() == []
+
+    def test_held_by_me_tracks_owner(self, witness):
+        lk = locks.TrackedLock("t.owner")
+        seen = {}
+        with lk:
+            assert lk.held_by_me()
+            t = threading.Thread(
+                target=lambda: seen.setdefault("other", lk.held_by_me()))
+            t.start()
+            t.join()
+        assert seen["other"] is False
+        assert not lk.held_by_me()
+
+    def test_disarmed_lock_is_invisible(self):
+        if locks.armed():
+            pytest.skip("session armed by env; disarmed path covered "
+                        "in the default leg")
+        a, b = locks.TrackedLock("t.off.a"), locks.TrackedLock("t.off.b")
+        before = racecheck.edge_count()
+        with a:
+            with b:
+                time.sleep(0.01)
+        assert racecheck.edge_count() == before
+        assert racecheck.findings() == []
+
+
+class TestGuardedAudit:
+    def test_rc003_unlocked_access(self, witness):
+        lk = locks.TrackedLock("t.g.lock")
+        g = locks.Guarded({}, lk, "t.g.state")
+        g["k"] = 1  # no lock held
+        found = witness.findings()
+        assert _rules(found) == ["RC003"]
+        line = found[0].render()
+        assert line.startswith("tests/test_racecheck.py:")
+        assert "t.g.state" in line and "t.g.lock" in line
+
+    def test_locked_access_is_clean(self, witness):
+        lk = locks.TrackedLock("t.g2.lock")
+        g = locks.Guarded({}, lk, "t.g2.state")
+        with lk:
+            g["k"] = 1
+            assert g["k"] == 1
+            assert len(g) == 1 and "k" in g
+        assert witness.findings() == []
+
+    def test_foreign_thread_holding_is_still_a_violation(self, witness):
+        # the OWNING lock must be held BY THE ACCESSING thread.
+        # long_hold_ok: the main thread holds lk across the child's
+        # whole lifecycle (incl. the finding's fsync'd flightrec dump)
+        # — an RC002 here would be the harness, not the contract
+        lk = locks.TrackedLock("t.g3.lock", long_hold_ok=True)
+        g = locks.Guarded({}, lk, "t.g3.state")
+        lk.acquire()
+        try:
+            t = threading.Thread(target=lambda: g.get("k"))
+            t.start()
+            t.join()
+        finally:
+            lk.release()
+        assert _rules(witness.findings()) == ["RC003"]
+
+    def test_unwrap_bypasses_the_audit(self, witness):
+        lk = locks.TrackedLock("t.g4.lock")
+        g = locks.Guarded({"k": 1}, lk, "t.g4.state")
+        assert g.unwrap()["k"] == 1
+        assert witness.findings() == []
+
+    def test_rc004_thread_affinity(self, witness):
+        class Owned:
+            @locks.thread_affine
+            def touch(self):
+                return threading.get_ident()
+
+        obj = Owned()
+        obj.touch()  # binds to this thread
+        t = threading.Thread(target=obj.touch, name="foreign")
+        t.start()
+        t.join()
+        found = witness.findings()
+        assert _rules(found) == ["RC004"]
+        assert "Owned.touch" in found[0].render()
+        # reset_affinity hands the object to a new owner legitimately
+        racecheck.reset()
+        locks.reset_affinity(obj)
+        t2 = threading.Thread(target=obj.touch)
+        t2.start()
+        t2.join()
+        assert witness.findings() == []
+
+    def test_findings_feed_metrics_and_flightrec(self, witness, tmp_path):
+        from reporter_tpu.obs import flightrec
+        old_dir = flightrec.dump_dir()
+        flightrec.set_dump_dir(str(tmp_path))
+        try:
+            c0 = metrics.default.counter("racecheck.findings")
+            lk = locks.TrackedLock("t.m.lock")
+            locks.Guarded({}, lk, "t.m.state")["k"] = 1
+            assert metrics.default.counter("racecheck.findings") == c0 + 1
+            assert metrics.default.counter("racecheck.RC003") >= 1
+            dumps = [p.name for p in tmp_path.iterdir()
+                     if "racecheck.RC003" in p.name]
+            assert dumps, "no flight-recorder postmortem for the finding"
+        finally:
+            if old_dir:
+                flightrec.set_dump_dir(old_dir)
+
+
+class TestRawMode:
+    def test_raw_hands_out_bare_locks_and_refuses_arming(self, witness,
+                                                         monkeypatch):
+        monkeypatch.setattr(locks, "_RAW", True)
+        lk = locks.new_lock("t.raw")
+        assert not isinstance(lk, locks.TrackedLock)
+        with pytest.raises(RuntimeError, match="raw"):
+            locks.arm()
+
+    def test_new_lock_default_is_tracked(self):
+        lk = locks.new_lock("t.tracked")
+        assert isinstance(lk, locks.TrackedLock)
+        assert lk.name == "t.tracked"
+
+
+class TestFuzzLayer:
+    def test_spec_grammar(self):
+        s = locks.parse_fuzz_spec("7")
+        assert (s.seed, s.prob, s.max_us) == (7, 0.25, 200.0)
+        s = locks.parse_fuzz_spec("7:0.5@400")
+        assert (s.seed, s.prob, s.max_us) == (7, 0.5, 400.0)
+        s = locks.parse_fuzz_spec("9@50")
+        assert (s.seed, s.prob, s.max_us) == (9, 0.25, 50.0)
+        for bad in ("", "x", "7:0", "7:1.5", "7@0", "7@-3", "7:a@b"):
+            with pytest.raises(ValueError):
+                locks.parse_fuzz_spec(bad)
+
+    def test_replay_is_bit_identical_by_seed(self):
+        def drive(seed):
+            spec = locks._FuzzSpec(seed, prob=0.5, max_us=1.0)
+            progression = []
+            for _ in range(64):
+                spec.maybe_yield("test.site")
+                progression.append(spec.yields)
+            return progression
+
+        assert drive(5) == drive(5)
+        # per-site streams are independent: a second site draws its own
+        # sequence from crc32(site) ^ seed, unaffected by the first
+        spec = locks._FuzzSpec(5, prob=0.5, max_us=1.0)
+        for _ in range(10):
+            spec.maybe_yield("other.site")
+        base = spec.yields
+        for _ in range(64):
+            spec.maybe_yield("test.site")
+        assert spec.yields - base == drive(5)[-1]
+
+    def test_disarmed_fuzz_point_is_a_noop(self):
+        assert locks.fuzz_yields() == 0 or locks._FUZZ is not None
+        locks.configure_fuzz(None)
+        locks.fuzz_point("test.site")  # must not raise, must not sleep
+        assert locks.fuzz_yields() == 0
+
+    def test_configure_rejects_malformed(self):
+        with pytest.raises(ValueError):
+            locks.configure_fuzz("not-a-spec")
+        locks.configure_fuzz(None)
+
+
+class TestDispatcherShutdown:
+    """ISSUE 10 satellite: close() while chunks are in flight on both
+    device lanes — the drain completes, no slot is orphaned, metrics
+    stay consistent."""
+
+    @pytest.fixture(scope="class")
+    def city(self):
+        from reporter_tpu.synth import build_grid_city
+        return build_grid_city(rows=8, cols=8, spacing_m=200.0, seed=2,
+                               service_road_fraction=0.0,
+                               internal_fraction=0.0)
+
+    def _reqs(self, city, n):
+        from reporter_tpu.synth import generate_trace
+        rng = np.random.default_rng(3)
+        reqs = []
+        for i in range(n):
+            tr = None
+            while tr is None:
+                tr = generate_trace(city, f"veh-{i}", rng, noise_m=3.0,
+                                    min_route_edges=8)
+            reqs.append(tr.request_json())
+        return reqs
+
+    def test_close_drains_in_flight_chunks(self, city):
+        from reporter_tpu.matcher import SegmentMatcher
+        from reporter_tpu.service.dispatch import BatchDispatcher
+
+        matcher = SegmentMatcher(net=city)  # real device lanes
+        t0 = metrics.default.counter("dispatch.traces")
+        # gate the first batch INSIDE the match fn: close() is then
+        # guaranteed to land while that batch is in flight on the lanes
+        # and the rest are still queued — in-flight by construction,
+        # not by sleeping
+        started, release = threading.Event(), threading.Event()
+
+        def gated_match(traces):
+            started.set()
+            assert release.wait(120.0)
+            return matcher.match_many(traces)
+
+        # small batches so the submissions span several device batches
+        disp = BatchDispatcher(gated_match, max_batch=2, max_wait_ms=5.0)
+        reqs = self._reqs(city, 6)
+        box = {}
+
+        def submit_all():
+            try:
+                box["results"] = disp.submit_many(reqs, timeout=120.0)
+            except Exception as e:  # surfaced below, not swallowed
+                box["error"] = e
+
+        t = threading.Thread(target=submit_all)
+        t.start()
+        assert started.wait(120.0)  # batch 1 is in flight on the lanes
+        deadline = time.monotonic() + 120.0
+        while disp._queue.qsize() < len(reqs) - disp.max_batch:
+            assert time.monotonic() < deadline
+            time.sleep(0.005)
+        closer = threading.Thread(target=disp.close,
+                                  kwargs={"timeout": 120.0})
+        closer.start()
+        release.set()  # let the lanes run; the loop must drain ALL slots
+        closer.join(120.0)
+        t.join(120.0)
+        assert not t.is_alive() and not closer.is_alive()
+        assert "error" not in box, box
+        results = box["results"]
+        assert len(results) == len(reqs)
+        for res in results:
+            assert "segments" in res, res  # MatchRuns mapping view or dict
+        # no orphaned slots: the queue is empty and fully accounted
+        assert disp._queue.empty()
+        assert metrics.default.counter("dispatch.traces") - t0 == len(reqs)
+        assert not disp._thread.is_alive()
+        # idempotent, and the door is shut
+        assert disp.close() is True
+        with pytest.raises(RuntimeError, match="closed"):
+            disp.submit(reqs[0], timeout=1.0)
+
+    def test_close_wakes_slots_stranded_behind_the_sentinel(self, city):
+        # a submit that raced past the closed check can enqueue AFTER
+        # the sentinel; close() must wake it with an error instead of
+        # leaving it to burn its full wait timeout
+        from reporter_tpu.service.dispatch import BatchDispatcher, _Slot
+
+        disp = BatchDispatcher(lambda traces: [{"segments": []}
+                                               for _ in traces],
+                               max_batch=4, max_wait_ms=5.0)
+        assert disp.close(timeout=30.0) is True
+        late = _Slot({"uuid": "late"})
+        disp._queue.put(late)
+        assert disp.close(timeout=30.0) is True  # re-run the sweep
+        assert late.event.is_set()
+        assert isinstance(late.error, RuntimeError)
+
+
+class TestWorkerStopOrdering:
+    """ISSUE 10 satellite: StreamWorker stop joins the shadow-accuracy
+    pool and pauses the drainer BEFORE the final flush — no thread
+    outlives the spool/datastore handles."""
+
+    def test_stop_under_load(self, tmp_path, monkeypatch):
+        from reporter_tpu.matcher import SegmentMatcher
+        from reporter_tpu.obs import profiler
+        from reporter_tpu.service.server import ReporterService
+        from reporter_tpu.streaming.anonymiser import Anonymiser, TileSink
+        from reporter_tpu.streaming.formatter import Formatter
+        from reporter_tpu.streaming.worker import (StreamWorker,
+                                                   inproc_submitter)
+        from reporter_tpu.synth import build_grid_city, generate_trace
+
+        # every chunk sampled -> the shadow pool is busy at stop time;
+        # a huge replay interval -> the drainer exists but only the
+        # final drain_now + pause touch it
+        monkeypatch.setenv("REPORTER_TPU_SHADOW_SAMPLE", "1.0")
+        monkeypatch.setenv("REPORTER_TPU_REPLAY_INTERVAL_S", "1e9")
+
+        city = build_grid_city(rows=8, cols=8, spacing_m=200.0, seed=5,
+                               service_road_fraction=0.0,
+                               internal_fraction=0.0)
+        service = ReporterService(SegmentMatcher(net=city),
+                                  threshold_sec=15, max_batch=16,
+                                  max_wait_ms=5.0)
+        rng = np.random.default_rng(7)
+        lines = []
+        for i in range(6):
+            tr = None
+            while tr is None:
+                tr = generate_trace(city, f"veh-{i}", rng, noise_m=3.0,
+                                    min_route_edges=8)
+            lines.extend("|".join([tr.uuid, str(p["lat"]), str(p["lon"]),
+                                   str(p["time"]), str(p["accuracy"])])
+                         for p in tr.points)
+        worker = StreamWorker(
+            Formatter.from_config(",sv,\\|,0,1,2,3,4"),
+            inproc_submitter(service),
+            Anonymiser(TileSink(str(tmp_path / "out"),
+                                deadletter=str(tmp_path / "spool")),
+                       privacy=1, quantisation=3600, source="stoptest"),
+            reports="0,1,2", transitions="0,1,2", flush_interval_s=1e9,
+            submit_many=service.report_many)
+        assert worker.drainer is not None
+
+        worker.run(lines)  # run() ends in drain(): the ordered stop
+
+        assert worker.processed == len(lines)
+        assert worker.parse_failures == 0
+        # (1) the shadow pool was joined: no sampler thread survives,
+        # and the module handle is gone (a later maybe_shadow would
+        # lazily rebuild — this stop owed it a full join)
+        assert profiler._shadow_pool is None
+        assert not [t for t in threading.enumerate()
+                    if t.name.startswith("shadow-decode")]
+        # (2) the drainer is paused: a stray maybe_drain after stop is
+        # a no-op instead of a write into released handles
+        assert worker.drainer._paused is True
+        assert worker.drainer.maybe_drain() == 0
+        service.dispatcher.close()
+
+
+class TestRendererContract:
+    def test_findings_render_as_rule_lines(self, witness):
+        lk = locks.TrackedLock("t.r.lock")
+        locks.Guarded({}, lk, "t.r.state")["k"] = 1
+        lines = witness.render()
+        assert len(lines) == 1
+        path, line_no, rest = lines[0].split(":", 2)
+        assert path == "tests/test_racecheck.py"
+        assert int(line_no) > 0
+        assert rest.strip().startswith("RC003")
+
+    def test_rules_are_registered_in_the_catalogue(self):
+        from reporter_tpu import analysis
+        for rule in ("RC001", "RC002", "RC003", "RC004"):
+            assert rule in racecheck.RULES
+            assert rule in analysis.ALL_RULES
+
+    def test_reset_clears_graph_and_findings(self, witness):
+        a, b = locks.TrackedLock("t.rst.a"), locks.TrackedLock("t.rst.b")
+        with a:
+            with b:
+                pass
+        locks.Guarded({}, a, "t.rst.state")["k"] = 1
+        assert witness.edge_count() == 1 and witness.findings()
+        witness.reset()
+        assert witness.edge_count() == 0
+        assert witness.findings() == []

@@ -61,6 +61,11 @@ def main(argv=None) -> int:
                              "(registry drift, fault coverage, "
                              "durability, lock graph); ignores the "
                              "baseline — fast pre-commit guard")
+    parser.add_argument("--locks-only", action="store_true",
+                        help="run only the static lock passes (LD001 "
+                             "discipline + LD002/LD003 lock graph); "
+                             "ignores the baseline — fast pre-commit "
+                             "guard for concurrency changes")
     parser.add_argument("--abi-cpp", default=None,
                         help="override the C++ runtime source path")
     parser.add_argument("--abi-py", default=None,
@@ -101,6 +106,21 @@ def main(argv=None) -> int:
                   "mismatch(es)", file=sys.stderr)
             return 1
         print("reporter-lint --abi-only: binding matches the C++ runtime")
+        return 0
+
+    if args.locks_only:
+        files = analysis.collect_py_files(REPO_ROOT, DEFAULT_ROOTS)
+        findings = sorted(analysis.filter_suppressed(
+            [*analysis.locks.run(files, REPO_ROOT),
+             *analysis.lockgraph.run(files, REPO_ROOT)], files))
+        for f in findings:
+            print(f.render())
+        if findings:
+            print(f"reporter-lint --locks-only: {len(findings)} lock "
+                  "finding(s)", file=sys.stderr)
+            return 1
+        print(f"reporter-lint --locks-only: lock discipline holds "
+              f"({len(files)} files)")
         return 0
 
     if args.contracts_only:

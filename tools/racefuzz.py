@@ -1,0 +1,365 @@
+#!/usr/bin/env python
+"""Schedule-perturbation fuzzer + disarmed-overhead gate (ISSUE 10).
+
+The runtime witness (reporter_tpu/analysis/racecheck.py) only reports
+interleavings that actually happen. This harness makes the unlikely ones
+happen: ``REPORTER_TPU_RACEFUZZ=seed[:prob][@max_us]`` injects seeded
+microsecond yields at every TrackedLock acquire and the dispatcher's
+queue put/get sites (per-site RNG seeded ``crc32(site) ^ seed`` — the
+faults-layer replay discipline, bit-identical draw sequences by seed),
+then the scenarios below run with the witness + guarded-state audit
+armed. ANY RC finding fails the run and prints the replay seed.
+
+Scenarios (each runs in its own interpreter so env arming and the
+held-before graph start clean):
+
+  replay        traced multi-writer replay: 2 writer workers x one
+                shared service + datastore tee (the bigreplay topology
+                at smoke scale), REPORTER_TPU_TRACE=1 and shadow
+                sampling on, final drain -> witness findings must be
+                empty and perturbation must actually have fired
+  submit_burst  tools/chaos.py submit_burst under perturbation
+                (requeue/dead-letter paths racing the stream thread)
+  storm         tools/chaos.py storm under perturbation (circuit
+                breaker + fallback lane handoff; skips without the
+                native runtime like chaos itself)
+
+Usage:
+  REPORTER_TPU_PLATFORM=cpu python tools/racefuzz.py --seeds 3
+  REPORTER_TPU_PLATFORM=cpu python tools/racefuzz.py --seed 7   # replay one
+  REPORTER_TPU_PLATFORM=cpu python tools/racefuzz.py --overhead
+
+``--overhead`` is the disarmed-cost gate: the serialized
+(REPORTER_TPU_PIPELINE=0) 512-trace match, tracked-but-disarmed locks
+(the shipped default) vs ``REPORTER_TPU_LOCKCHECK=raw`` bare
+``threading.Lock``s, interleaved repeats, min-of-N per leg, pinned
+< 2%.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("REPORTER_TPU_PLATFORM", "cpu")  # never probe a chip
+
+DEFAULT_SCENARIOS = ("replay", "submit_burst", "storm")
+FMT = r",sv,\|,0,1,2,3,4"  # uuid|lat|lon|time|accuracy
+OVERHEAD_TRACES = 512
+OVERHEAD_LIMIT_PCT = 2.0
+
+
+def log(msg: str) -> None:
+    print(f"racefuzz: {msg}", flush=True)
+
+
+# ---- child legs (run in a fresh interpreter, armed by env) -----------------
+
+def _check_findings(context: str) -> int:
+    """Zero-findings gate every drive leg ends on. Renders each finding
+    in the PR 2 ``path:line: RULE-ID`` form."""
+    from reporter_tpu.analysis import racecheck
+    lines = racecheck.render()
+    for line in lines:
+        print(line)
+    if lines:
+        sys.stderr.write(
+            f"racefuzz: FAIL: {len(lines)} witness finding(s) in "
+            f"{context}\n")
+        return 1
+    log(f"{context}: 0 findings "
+        f"(held-before edges observed: {racecheck.edge_count()})")
+    return 0
+
+
+def drive_replay() -> int:
+    """Traced multi-writer replay: the bigreplay topology at smoke
+    scale. Two writer workers share one service (one dispatcher, one
+    matcher, its device lanes) and one datastore tee; each writer owns
+    its anonymiser/sink. The perturbed schedule must still produce a
+    clean run AND a clean witness."""
+    import tempfile
+    import threading
+
+    import numpy as np
+
+    from reporter_tpu.datastore import LocalDatastore
+    from reporter_tpu.matcher import SegmentMatcher
+    from reporter_tpu.service.server import ReporterService
+    from reporter_tpu.streaming.anonymiser import Anonymiser, TileSink
+    from reporter_tpu.streaming.formatter import Formatter
+    from reporter_tpu.streaming.worker import StreamWorker, inproc_submitter
+    from reporter_tpu.synth import build_grid_city, generate_trace
+    from reporter_tpu.utils import locks
+
+    if not locks.armed():
+        sys.stderr.write("racefuzz: FAIL: witness not armed in child "
+                         "(REPORTER_TPU_LOCKCHECK lost?)\n")
+        return 1
+
+    city = build_grid_city(rows=8, cols=8, spacing_m=200.0, seed=5,
+                           service_road_fraction=0.0,
+                           internal_fraction=0.0)
+    rng = np.random.default_rng(11)
+    shards = [[], []]
+    for i in range(12):
+        tr = None
+        while tr is None:
+            tr = generate_trace(city, f"veh-{i}", rng, noise_m=3.0,
+                                min_route_edges=8)
+        shards[i % 2].extend(
+            "|".join([tr.uuid, str(p["lat"]), str(p["lon"]),
+                      str(p["time"]), str(p["accuracy"])])
+            for p in tr.points)
+
+    with tempfile.TemporaryDirectory() as workdir:
+        store = LocalDatastore(os.path.join(workdir, "store"))
+
+        def tee(_tile, segments, ingest_key=None):
+            return store.ingest_segments(segments, ingest_key=ingest_key)
+
+        service = ReporterService(SegmentMatcher(net=city),
+                                  threshold_sec=15, max_batch=64,
+                                  max_wait_ms=5.0)
+        workers, threads = [], []
+        for w, shard in enumerate(shards):
+            anon = Anonymiser(
+                TileSink(os.path.join(workdir, "out"),
+                         deadletter=os.path.join(workdir, f"spool-w{w}")),
+                privacy=1, quantisation=3600, source="fuzz", tee=tee)
+            anon.writer_id = f"w{w}"
+            worker = StreamWorker(
+                Formatter.from_config(FMT), inproc_submitter(service),
+                anon, reports="0,1,2", transitions="0,1,2",
+                flush_interval_s=1e9, submit_many=service.report_many,
+                report_flush_interval_s=0.5, datastore=store)
+            workers.append(worker)
+            threads.append(threading.Thread(target=worker.run,
+                                            args=(iter(shard),),
+                                            daemon=True))
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        service.dispatcher.close()
+
+        fails = sum(w.parse_failures for w in workers)
+        if fails:
+            sys.stderr.write(f"racefuzz: FAIL: {fails} parse failures "
+                             "in the replay\n")
+            return 1
+        yields = locks.fuzz_yields()
+        if os.environ.get(locks.ENV_FUZZ) and yields == 0:
+            sys.stderr.write("racefuzz: FAIL: perturbation armed but "
+                             "zero yields fired — the hooks are dead\n")
+            return 1
+        log(f"replay: {sum(len(s) for s in shards)} probes, "
+            f"2 writers, {yields} perturbation yields")
+        return _check_findings("replay")
+
+
+def drive_chaos(scenario: str) -> int:
+    """One tools/chaos.py scenario under the armed witness + fuzz."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import chaos
+    rc = getattr(chaos, f"scenario_{scenario}")()
+    if rc != 0:
+        sys.stderr.write(
+            f"racefuzz: FAIL: chaos {scenario} rc={rc} under "
+            "perturbation\n")
+        return rc
+    from reporter_tpu.utils import locks
+    log(f"{scenario}: chaos leg clean, "
+        f"{locks.fuzz_yields()} perturbation yields")
+    return _check_findings(scenario)
+
+
+def drive_overhead() -> int:
+    """One timed leg of the A/B: serialized 512-trace match_many.
+    Prints a JSON line the parent parses; the lock flavour is reported
+    so the parent can prove each leg ran what it thinks it ran."""
+    import numpy as np
+
+    from reporter_tpu.matcher import SegmentMatcher
+    from reporter_tpu.synth import build_grid_city, generate_trace
+    from reporter_tpu.utils import metrics
+
+    city = build_grid_city(rows=8, cols=8, spacing_m=200.0, seed=5,
+                           service_road_fraction=0.0,
+                           internal_fraction=0.0)
+    rng = np.random.default_rng(23)
+    reqs = []
+    for i in range(64):
+        tr = None
+        while tr is None:
+            tr = generate_trace(city, f"veh-{i}", rng, noise_m=3.0,
+                                min_route_edges=8)
+        reqs.append(tr.request_json())
+    reqs = (reqs * ((OVERHEAD_TRACES // len(reqs)) + 1))[:OVERHEAD_TRACES]
+
+    matcher = SegmentMatcher(net=city)
+    matcher.match_many(reqs[:32])  # warm: compile + caches off the clock
+    t0 = time.perf_counter()
+    out = matcher.match_many(reqs)
+    ms = (time.perf_counter() - t0) * 1e3
+    print(json.dumps({
+        "ms": round(ms, 2), "traces": len(out),
+        "lock_type": type(metrics.default._lock).__name__}), flush=True)
+    return 0
+
+
+# ---- parent orchestration ---------------------------------------------------
+
+def _child_env(**extra: str) -> dict:
+    env = dict(os.environ)
+    env["REPORTER_TPU_PLATFORM"] = "cpu"
+    # a pre-armed operator shell must not leak into the legs: each leg
+    # states its own arming exactly
+    for var in ("REPORTER_TPU_LOCKCHECK", "REPORTER_TPU_RACEFUZZ",
+                "REPORTER_TPU_TRACE", "REPORTER_TPU_SHADOW_SAMPLE",
+                "REPORTER_TPU_PIPELINE"):
+        env.pop(var, None)
+    env.update(extra)
+    return env
+
+
+def _run_child(scenario: str, env: dict) -> "subprocess.CompletedProcess":
+    return subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--drive", scenario],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=900)
+
+
+def run_fuzz(seeds, scenarios, prob: float, max_us: float) -> int:
+    failures = []
+    for seed in seeds:
+        for scenario in scenarios:
+            spec = f"{seed}:{prob}@{max_us:g}"
+            log(f"seed {seed} / {scenario} "
+                f"(REPORTER_TPU_RACEFUZZ={spec}) ...")
+            t0 = time.monotonic()
+            proc = _run_child(scenario, _child_env(
+                REPORTER_TPU_LOCKCHECK="1",
+                REPORTER_TPU_RACEFUZZ=spec,
+                REPORTER_TPU_TRACE="1",
+                REPORTER_TPU_SHADOW_SAMPLE="0.5"))
+            dt = time.monotonic() - t0
+            if proc.returncode != 0:
+                failures.append((seed, scenario))
+                sys.stdout.write(proc.stdout)
+                sys.stderr.write(proc.stderr)
+                log(f"seed {seed} / {scenario}: FAIL ({dt:.1f}s) — "
+                    f"replay with: REPORTER_TPU_PLATFORM=cpu python "
+                    f"tools/racefuzz.py --seed {seed} "
+                    f"--scenarios {scenario}")
+            else:
+                tail = [ln for ln in proc.stdout.splitlines()
+                        if ln.startswith("racefuzz:")]
+                for ln in tail[-2:]:
+                    print("  " + ln)
+                log(f"seed {seed} / {scenario}: ok ({dt:.1f}s)")
+    if failures:
+        sys.stderr.write(
+            "racefuzz: FAIL: findings under "
+            + ", ".join(f"seed {s} ({sc})" for s, sc in failures) + "\n")
+        return 1
+    log(f"clean: {len(seeds)} seed(s) x {len(scenarios)} scenario(s), "
+        "0 findings")
+    return 0
+
+
+def run_overhead(repeats: int) -> int:
+    """Interleaved A/B, min-of-N per leg: raw threading.Lock (A) vs
+    tracked-but-disarmed TrackedLock (B, the shipped default)."""
+    legs = {"raw": [], "disarmed": []}
+    types = {}
+    for r in range(repeats):
+        for leg, env in (
+                ("raw", _child_env(REPORTER_TPU_LOCKCHECK="raw",
+                                   REPORTER_TPU_PIPELINE="0")),
+                ("disarmed", _child_env(REPORTER_TPU_PIPELINE="0"))):
+            proc = _run_child("overhead", env)
+            if proc.returncode != 0:
+                sys.stdout.write(proc.stdout)
+                sys.stderr.write(proc.stderr)
+                sys.stderr.write(f"racefuzz: FAIL: overhead {leg} leg "
+                                 f"rc={proc.returncode}\n")
+                return 1
+            rec = json.loads(proc.stdout.strip().splitlines()[-1])
+            if rec["traces"] != OVERHEAD_TRACES:
+                sys.stderr.write("racefuzz: FAIL: overhead leg matched "
+                                 f"{rec['traces']} traces\n")
+                return 1
+            legs[leg].append(rec["ms"])
+            types[leg] = rec["lock_type"]
+            log(f"overhead round {r + 1}/{repeats} {leg}: "
+                f"{rec['ms']:.1f} ms ({rec['lock_type']})")
+    if types.get("raw") != "lock" or types.get("disarmed") != "TrackedLock":
+        sys.stderr.write(
+            f"racefuzz: FAIL: A/B legs ran the wrong lock flavours "
+            f"({types}) — the comparison is meaningless\n")
+        return 1
+    raw = min(legs["raw"])
+    disarmed = min(legs["disarmed"])
+    pct = (disarmed - raw) / raw * 100.0
+    log(f"serialized {OVERHEAD_TRACES}-trace A/B: raw {raw:.1f} ms vs "
+        f"disarmed TrackedLock {disarmed:.1f} ms -> {pct:+.2f}% "
+        f"(limit +{OVERHEAD_LIMIT_PCT:.0f}%)")
+    if pct > OVERHEAD_LIMIT_PCT:
+        sys.stderr.write("racefuzz: FAIL: disarmed lock overhead "
+                         f"{pct:+.2f}% exceeds {OVERHEAD_LIMIT_PCT}%\n")
+        return 1
+    log("overhead gate: ok")
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--seeds", type=int, default=None,
+                        help="run seeds base..base+N-1 (default 3 when "
+                             "neither --seeds nor --seed given)")
+    parser.add_argument("--seed", type=int, action="append", default=None,
+                        help="run exactly this seed (repeatable) — the "
+                             "replay knob a failure report prints")
+    parser.add_argument("--base-seed", type=int, default=1,
+                        help="first seed for --seeds (default 1)")
+    parser.add_argument("--prob", type=float, default=0.25,
+                        help="per-site yield probability (default 0.25)")
+    parser.add_argument("--max-us", type=float, default=200.0,
+                        help="max injected yield in microseconds")
+    parser.add_argument("--scenarios", nargs="+",
+                        default=list(DEFAULT_SCENARIOS),
+                        choices=list(DEFAULT_SCENARIOS),
+                        help="scenario subset (default: all)")
+    parser.add_argument("--overhead", action="store_true",
+                        help="run the disarmed-overhead A/B gate "
+                             "instead of fuzzing")
+    parser.add_argument("--repeats", type=int, default=3,
+                        help="interleaved rounds per overhead leg")
+    parser.add_argument("--drive", default=None,
+                        help=argparse.SUPPRESS)  # internal child mode
+    args = parser.parse_args(argv)
+
+    if args.drive:
+        if args.drive == "replay":
+            return drive_replay()
+        if args.drive == "overhead":
+            return drive_overhead()
+        return drive_chaos(args.drive)
+    if args.overhead:
+        return run_overhead(args.repeats)
+    if args.seed:
+        seeds = args.seed
+    else:
+        seeds = list(range(args.base_seed,
+                           args.base_seed + (args.seeds or 3)))
+    return run_fuzz(seeds, args.scenarios, args.prob, args.max_us)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,19 +1,27 @@
 #!/bin/sh
-# CI `sanitize` stage: build the native host runtime under asan and ubsan
+# CI `sanitize` stage: build the native host runtime under sanitizers
 # and run the native test files against the instrumented libraries.
 #
-# The Python interpreter itself stays uninstrumented — the asan runtime is
-# LD_PRELOADed so the instrumented .so can resolve its symbols, and leak
-# checking is off (CPython "leaks" by design at exit; we are after
-# overflows/UB in host_runtime.cpp, which the prep/assemble tests drive
-# hard). Skips cleanly (exit 0 with a notice) when the toolchain lacks
-# sanitizer support, per the CI contract.
+#   sanitize_tests.sh            # asan + ubsan (the `sanitize` stage)
+#   sanitize_tests.sh tsan       # ThreadSanitizer (the `racecheck` stage)
+#   sanitize_tests.sh asan|ubsan # one leg in isolation
+#
+# The Python interpreter itself stays uninstrumented — the sanitizer
+# runtime is LD_PRELOADed so the instrumented .so can resolve its
+# symbols, and leak checking is off (CPython "leaks" by design at exit;
+# we are after overflows/UB/races in host_runtime.cpp, which the
+# prep/assemble tests drive hard). The tsan leg runs with
+# REPORTER_TPU_PREP_THREADS=4 so the WorkerPool span handoff and the
+# striped route-memo's clock eviction actually race. Skips cleanly
+# (exit 0 with a notice) when the toolchain lacks sanitizer support,
+# per the CI contract.
 set -u
 
 ROOT="$(cd "$(dirname "$0")/.." && pwd)"
 NATIVE="$ROOT/reporter_tpu/native"
 CXX="${CXX:-g++}"
 TESTS="tests/test_native.py tests/test_native_batch.py tests/test_prep_v2.py"
+MODE="${1:-default}"
 
 probe() {
     # can this compiler link the sanitizer runtime at all?
@@ -25,7 +33,23 @@ cd "$ROOT" || exit 2
 rc=0
 ran=0
 
-if probe address; then
+case "$MODE" in
+    default|asan|ubsan|tsan) ;;
+    *) echo "sanitize: unknown mode '$MODE' (asan|ubsan|tsan)" >&2
+       exit 2 ;;
+esac
+
+# want <leg>: does the requested MODE include this leg? Legs are named
+# by their CLI mode (asan/ubsan/tsan), not the -fsanitize flag probe()
+# takes — default runs everything but tsan (the racecheck stage owns it)
+want() {
+    case "$MODE" in
+        default) [ "$1" != tsan ] ;;
+        *) [ "$MODE" = "$1" ] ;;
+    esac
+}
+
+if want asan && probe address; then
     ran=1
     echo "== sanitize: building + testing under AddressSanitizer =="
     make -C "$NATIVE" asan || exit 1
@@ -40,11 +64,11 @@ if probe address; then
     REPORTER_TPU_PREP_THREADS=2 \
     JAX_PLATFORMS=cpu \
         python -m pytest $TESTS -q -p no:cacheprovider || rc=1
-else
+elif want asan; then
     echo "== sanitize: $CXX lacks -fsanitize=address; skipping asan =="
 fi
 
-if probe undefined; then
+if want ubsan && probe undefined; then
     ran=1
     echo "== sanitize: building + testing under UBSan =="
     make -C "$NATIVE" ubsan || exit 1
@@ -53,12 +77,44 @@ if probe undefined; then
     REPORTER_TPU_PREP_THREADS=2 \
     JAX_PLATFORMS=cpu \
         python -m pytest $TESTS -q -p no:cacheprovider || rc=1
-else
+elif want ubsan; then
     echo "== sanitize: $CXX lacks -fsanitize=undefined; skipping ubsan =="
 fi
 
+if want tsan && probe thread; then
+    libtsan="$("$CXX" -print-file-name=libtsan.so)"
+    # TSan into an uninstrumented host interpreter is best-effort: the
+    # preloaded runtime must survive interpreter startup (some
+    # glibc/libtsan pairings abort on "unexpected memory mapping").
+    # Probe that before committing the leg — an unusable pairing is a
+    # toolchain absence, not a failure, per the skip contract.
+    if LD_PRELOAD="$libtsan" TSAN_OPTIONS="report_bugs=0:exitcode=0" \
+            python -c "pass" >/dev/null 2>&1; then
+        ran=1
+        echo "== sanitize: building + testing under ThreadSanitizer =="
+        make -C "$NATIVE" tsan || exit 1
+        # the tsan leg drives tools/tsan_native_drive.py, NOT pytest:
+        # the pytest harness deadlocks under a preloaded libtsan on
+        # common glibc pairings (every thread asleep at the first
+        # test), and a CI stage must never hang. The driver covers the
+        # same native concurrency surface (WorkerPool span handoff,
+        # striped route-memo eviction, thread-count bit-identity) —
+        # see its module docstring.
+        LD_PRELOAD="$libtsan" \
+        TSAN_OPTIONS="halt_on_error=1:report_thread_leaks=0:report_signal_unsafe=0" \
+        REPORTER_TPU_NATIVE_LIB="$NATIVE/libreporter_host_tsan.so" \
+        REPORTER_TPU_PREP_THREADS=4 \
+        JAX_PLATFORMS=cpu \
+            python tools/tsan_native_drive.py || rc=1
+    else
+        echo "== sanitize: libtsan cannot preload into this interpreter; skipping tsan =="
+    fi
+elif want tsan; then
+    echo "== sanitize: $CXX lacks -fsanitize=thread; skipping tsan =="
+fi
+
 if [ "$ran" = 0 ]; then
-    echo "== sanitize: no sanitizer support in this toolchain; skipped =="
+    echo "== sanitize: no sanitizer support in this toolchain ($MODE); skipped =="
     exit 0
 fi
 exit $rc

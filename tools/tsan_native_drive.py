@@ -1,0 +1,179 @@
+#!/usr/bin/env python
+"""ThreadSanitizer driver for the native host runtime (ISSUE 10).
+
+`sanitize_tests.sh tsan` runs THIS script — not pytest — against the
+`-fsanitize=thread` build. The pytest harness deadlocks under a
+preloaded libtsan on common glibc pairings (observed: the session hangs
+at the first test with every thread asleep, while the identical
+operations in a plain script run clean), and a CI stage must never
+hang. So the tsan leg drives the same native concurrency surface the
+native test files cover, directly:
+
+- WorkerPool span handoff: `prepare_batch` with
+  ``REPORTER_TPU_PREP_THREADS=4`` shards spans across the pool with no
+  phase barrier — the handoff of staged buffers between the submitting
+  thread and the workers is exactly what TSan instruments.
+- Striped route-memo clock eviction: a small
+  ``REPORTER_TPU_ROUTE_MEMO`` bound forces concurrent whole-row
+  lookups/inserts AND evictions from all four workers at once.
+- Bit-identity contracts ride along (thread counts 1/2/5 must produce
+  identical tensors; eviction pressure must not change a value), so the
+  leg still fails on a *logic* race TSan happens not to flag.
+
+Any TSan report aborts the process (``halt_on_error=1`` in the caller's
+TSAN_OPTIONS) and fails the leg; any parity failure exits 1.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import threading
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("REPORTER_TPU_PLATFORM", "cpu")  # never probe a chip
+
+PREP_KEYS = ("edge_ids", "dist_m", "offset_m", "route_m", "gc_m", "case",
+             "kept_idx", "num_kept", "dwell", "has_cands", "max_finite")
+
+
+def log(msg: str) -> None:
+    print(f"tsan-drive: {msg}", flush=True)
+
+
+def fail(msg: str) -> int:
+    sys.stderr.write(f"tsan-drive: FAIL: {msg}\n")
+    return 1
+
+
+def main() -> int:
+    import numpy as np
+
+    from reporter_tpu import native
+    from reporter_tpu.core.geo import equirectangular_m
+    from reporter_tpu.graph import SpatialGrid
+    from reporter_tpu.matcher import MatchParams, SegmentMatcher
+    from reporter_tpu.matcher.batchpad import prepare_batch
+    from reporter_tpu.synth import build_grid_city, generate_trace
+
+    if not native.available():
+        # the shell wrapper already proved the toolchain and built the
+        # library; reaching here without it is a wiring error, not a skip
+        return fail("native runtime unavailable (REPORTER_TPU_NATIVE_LIB "
+                    "not set to the tsan build?)")
+
+    city = build_grid_city(rows=10, cols=10, spacing_m=200.0, seed=5)
+    rng = np.random.default_rng(11)
+    traces = []
+    while len(traces) < 20:
+        tr = generate_trace(city, f"p{len(traces)}", rng, noise_m=5.0,
+                            min_route_edges=3, max_route_edges=14)
+        if tr is not None and len(tr.points) >= 4:
+            traces.append(tr.points[:60])
+
+    # -- leg 1: batch-sorted candidate kernel vs the numpy grid ------------
+    matcher = SegmentMatcher(net=city, params=MatchParams(max_candidates=8))
+    grid = SpatialGrid(city)
+    pts = [p for t in traces for p in t]
+    lat = np.array([p["lat"] for p in pts])
+    lon = np.array([p["lon"] for p in pts])
+    c_np = grid.candidates(lat, lon, k=8)
+    c_nat = matcher.runtime.candidates(lat, lon, k=8)
+    if not np.array_equal(c_np.edge_ids, c_nat.edge_ids):
+        return fail("batch-sorted candidate edges diverge from SpatialGrid")
+    if not np.allclose(c_np.dist_m, c_nat.dist_m, atol=1e-3):
+        return fail("batch-sorted candidate distances diverge")
+    log(f"candidates parity: {len(pts)} points")
+
+    # -- leg 2: prep bit-identical across thread counts --------------------
+    outs = []
+    for n_threads in (1, 2, 5):
+        b = prepare_batch(matcher.runtime, traces, matcher.params, 64,
+                          n_threads=n_threads)
+        outs.append(b.prep)
+    for k in PREP_KEYS:
+        for other in outs[1:]:
+            if not np.array_equal(np.asarray(outs[0][k]),
+                                  np.asarray(other[k])):
+                return fail(f"prep key {k} differs across thread counts")
+    log("prep bit-identity: thread counts 1/2/5")
+
+    # -- leg 3: concurrent prep storm over the WorkerPool ------------------
+    # several Python threads each hammer their own runtime handle while
+    # the in-handle pool (REPORTER_TPU_PREP_THREADS, 4 in this leg)
+    # shards spans — TSan watches the staging-buffer handoff and every
+    # shared-memo row op; bit-identity to the quiet run rides along
+    errors: list = []
+    golden = outs[0]
+
+    def storm(rounds: int) -> None:
+        try:
+            m = SegmentMatcher(net=city,
+                               params=MatchParams(max_candidates=8))
+            for _ in range(rounds):
+                b = prepare_batch(m.runtime, traces, m.params, 64,
+                                  n_threads=4)
+                for k in PREP_KEYS:
+                    if not np.array_equal(np.asarray(b.prep[k]),
+                                          np.asarray(golden[k])):
+                        raise AssertionError(
+                            f"prep key {k} diverged under the storm")
+        except BaseException as e:  # surfaced below, never swallowed
+            errors.append(e)
+
+    threads = [threading.Thread(target=storm, args=(3,)) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    if errors:
+        return fail(f"concurrent prep storm: {errors[0]}")
+    log("concurrent prep storm: 4 threads x 3 rounds, parity held")
+
+    # -- leg 4: striped route-memo clock eviction under pressure -----------
+    os.environ["REPORTER_TPU_ROUTE_MEMO"] = "64"
+    try:
+        m = SegmentMatcher(net=city, params=MatchParams(max_candidates=8))
+        prepare_batch(m.runtime, traces, m.params, 64, n_threads=4)
+        a = prepare_batch(m.runtime, traces, m.params, 64, n_threads=4)
+        stats = m.runtime.route_memo_stats()
+        if stats["evictions"] <= 0:
+            return fail(f"route-memo bound never evicted ({stats})")
+        if stats["size"] > 64:
+            return fail(f"route-memo exceeded its bound ({stats})")
+    finally:
+        del os.environ["REPORTER_TPU_ROUTE_MEMO"]
+    for k in PREP_KEYS:
+        if not np.array_equal(np.asarray(a.prep[k]),
+                              np.asarray(golden[k])):
+            return fail(f"prep key {k} changed under memo eviction")
+    log(f"route-memo eviction: {stats['evictions']} evictions at "
+        f"bound 64, values exact")
+
+    # -- leg 5: cross-call memo reuse (whole-row hit path) ------------------
+    tr = None
+    rng2 = np.random.default_rng(4)
+    while tr is None:
+        tr = generate_trace(city, "memo", rng2, noise_m=4.0,
+                            min_route_edges=8)
+    m = SegmentMatcher(net=city, params=MatchParams(max_candidates=8))
+    mlat = np.array([p["lat"] for p in tr.points])
+    mlon = np.array([p["lon"] for p in tr.points])
+    cands = m.runtime.candidates(mlat, mlon, k=8)
+    gc = np.asarray(equirectangular_m(mlat[:-1], mlon[:-1], mlat[1:],
+                                      mlon[1:]), dtype=np.float32)
+    m.runtime.route_matrices(cands, gc)
+    s1 = m.runtime.route_memo_stats()
+    m.runtime.route_matrices(cands, gc)
+    s2 = m.runtime.route_memo_stats()
+    if not (s2["hits"] > s1["hits"] and s2["misses"] == s1["misses"]):
+        return fail(f"route-memo cross-call reuse broken ({s1} -> {s2})")
+    log("route-memo cross-call reuse: hit path exercised")
+
+    log("clean: all legs passed under the tsan build")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
