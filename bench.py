@@ -4,7 +4,8 @@ one-trace-at-a-time single-process architecture.
 
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": "traces/sec", "vs_baseline": N,
-   "stages": {...}, "baseline": {...}, "probe": {...}, "pallas": {...}}
+   "stages": {...}, "report_writers": {...}, "baseline": {...},
+   "probe": {...}, "pallas": {...}}
 
 Method: build a synthetic city, synthesise noisy GPS traces, then time
 two END-TO-END legs over the same traces (steady state: route caches
@@ -180,6 +181,12 @@ def _time_batched_leg(matcher, tb, reqs, make_report, repeats):
                     best_stages[f"prep_{phase}"] = round(ns / 1e9, 6)
             best_stages["report"] = round(elapsed - (t_match - t0), 6)
             best_stages["total"] = round(elapsed, 6)
+            # serialisation's share of the batch wall — the wire-path
+            # health number (ISSUE 11: the native writer's target is
+            # <=0.15 serialized, from ~0.27 with the Python columnar
+            # writer in BENCH_DEV_r06)
+            best_stages["report_share"] = round(
+                best_stages["report"] / elapsed, 4)
             # prep's share of the batch wall — the host-pipeline health
             # number (BENCH_r05: 62%; the columnar pipeline's target is
             # <35%). Under the device lanes prep overlaps decode, so
@@ -189,6 +196,81 @@ def _time_batched_leg(matcher, tb, reqs, make_report, repeats):
                 best_stages.get("prep", 0.0) / elapsed, 4)
             best_stages["pipelined"] = pipeline_enabled()
     return best, best_stages
+
+
+def _time_report_writers(matches, reqs, repeats=3):
+    """The serialisation stage in isolation, one leg per wire backend
+    over the SAME matches: the native C writer (bytes straight from run
+    columns in one GIL-released call), the Python columnar writer (the
+    fallback backend / parity oracle), and the legacy per-run-dict +
+    json.dumps path the pre-PR-4 service ran. Ratios between legs are
+    box-drift-proof (same process, same matches); the native leg is
+    None when the toolchain is unavailable."""
+    from reporter_tpu import native
+    from reporter_tpu.service import wire
+    from reporter_tpu.service.report import (_report_json_py, report,
+                                             report_wire)
+
+    mm_runs = [(m, r) for m, r in zip(matches, reqs)
+               if not isinstance(m, dict)]
+    if not mm_runs:
+        return None
+
+    def _leg(fn):
+        best = float("inf")
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            for match, req in mm_runs:
+                fn(match, req, 15, {0, 1, 2}, {0, 1, 2})
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    out = {"n_traces": len(mm_runs)}
+    python_s = _leg(_report_json_py)
+    out["python_s"] = round(python_s, 6)
+    # legacy dict path: dicts pre-materialised outside the timed loop —
+    # the pre-PR-4 service got them free from assembly, so charging
+    # materialisation here would overstate the win
+    plain = [({"segments": [dict(s) for s in m["segments"]],
+               "mode": m["mode"]}, r) for m, r in mm_runs]
+
+    def _dict_leg(match, req, thr, rep, trans):
+        return json.dumps(report(match, req, thr, rep, trans),
+                          separators=(",", ":"))
+
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        for match, req in plain:
+            _dict_leg(match, req, 15, {0, 1, 2}, {0, 1, 2})
+        best = min(best, time.perf_counter() - t0)
+    out["dict_s"] = round(best, 6)
+    out["dict_vs_python"] = round(best / python_s, 3)
+    if native.available() and wire.use_native():
+        def _native_leg():
+            best = float("inf")
+            for _ in range(repeats):
+                # drop the chunk memos so EVERY repeat pays the whole-
+                # chunk C emission plus its slice lookups — without
+                # this, repeats 2+ time pure dict hits and the
+                # committed native_vs_python ratio would overstate the
+                # writer (the serving path builds the memo once per
+                # chunk lifetime, which one repeat models exactly)
+                for match, _req in mm_runs:
+                    match.cols.arrays.pop("_wire_chunk", None)
+                t0 = time.perf_counter()
+                for match, req in mm_runs:
+                    report_wire(match, req, 15, {0, 1, 2}, {0, 1, 2})
+                best = min(best, time.perf_counter() - t0)
+            return best
+
+        native_s = _native_leg()
+        out["native_s"] = round(native_s, 6)
+        out["native_vs_python"] = round(native_s / python_s, 3)
+    else:
+        out["native_s"] = None
+        out["native_vs_python"] = None
+    return out
 
 
 def main():
@@ -257,11 +339,14 @@ def main():
     from reporter_tpu.matcher.assemble import assemble_segments
     from reporter_tpu.matcher.cpu_ref import viterbi_decode_numpy
     from reporter_tpu.ops import decode_backend
-    # report_json serialises the whole /report response: the batched leg
-    # takes the columnar writer (bytes straight from run columns), the
-    # baseline leg the reference-shaped dict + json.dumps path — each
-    # leg measures its own architecture end-to-end through the wire
+    # each leg measures its own architecture end-to-end through the
+    # wire: the batched leg serialises via report_wire — the serving
+    # path's entry point (native C writer emitting response bytes in
+    # one GIL-released call when armed, Python columnar writer
+    # otherwise) — while the baseline leg keeps report_json, which for
+    # its plain-dict matches IS the reference-shaped dict + json.dumps
     from reporter_tpu.service.report import report_json as make_report
+    from reporter_tpu.service.report import report_wire
 
     platform = jax.devices()[0].platform
 
@@ -311,9 +396,14 @@ def main():
         except Exception as e:
             print(f"profile pass failed (continuing): {e}",
                   file=sys.stderr)
-    best, stages = _time_batched_leg(matcher, tb, reqs, make_report,
+    best, stages = _time_batched_leg(matcher, tb, reqs, report_wire,
                                      repeats)
     batched_tps = n_traces / best
+
+    # -- wire-backend split: native vs Python vs legacy dict --------------
+    # one match pass, three serialisation legs over identical matches —
+    # the tentpole's isolated win, committed next to the stage share
+    report_writers = _time_report_writers(matcher.match_many(tb), reqs)
 
     # device-compute telemetry of the whole run (obs/profiler.py): a
     # steady-state bench should compile each decode shape exactly once
@@ -344,7 +434,7 @@ def main():
         try:
             matcher.match_many(reqs[:8])  # compile the pallas shapes
             p_best, p_stages = _time_batched_leg(
-                matcher, tb, reqs, make_report, max(2, repeats - 2))
+                matcher, tb, reqs, report_wire, max(2, repeats - 2))
             pallas_field = {"traces_per_sec": round(n_traces / p_best, 1),
                             "stages": p_stages}
         except Exception as e:  # record the failure, keep the artifact
@@ -368,6 +458,7 @@ def main():
         "unit": "traces/sec",
         "vs_baseline": round(batched_tps / baseline_tps, 2),
         "stages": stages,
+        "report_writers": report_writers,
         "baseline": {"traces_per_sec": round(baseline_tps, 1),
                      "n_traces": n_base, "repeats": base_repeats},
         "compile": compile_field,

@@ -311,6 +311,21 @@ def reset() -> None:
     _tls.held = []
 
 
+def fork_reset() -> None:
+    """Child-side post-fork reset (called by utils.locks' forksafe hook;
+    this module stays stdlib-only so it cannot register its own). The
+    graph lock is REBOUND, not acquired: a parent thread that held it at
+    fork time no longer exists to release it, and every acquisition it
+    recorded is a phantom in the child."""
+    global _graph_lock
+    _graph_lock = threading.Lock()
+    _edges.clear()
+    _adj.clear()
+    _findings.clear()
+    _reported.clear()
+    _tls.held = []
+
+
 __all__ = ["RULES", "enable", "disable", "note_acquired", "note_released",
            "note_guard_violation", "note_affinity_violation", "findings",
-           "render", "reset", "edge_count"]
+           "render", "reset", "edge_count", "fork_reset"]

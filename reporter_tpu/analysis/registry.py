@@ -42,6 +42,8 @@ ENV_KNOBS: Dict[str, str] = {
     "REPORTER_TPU_ROUTE_CACHE_NODES": "numpy route cache: node entries",
     "REPORTER_TPU_ROUTE_CACHE_PAIRS": "numpy route cache: pair entries",
     "REPORTER_TPU_WIRE": "f16|f32 device wire format",
+    "REPORTER_TPU_WIRE_NATIVE": "/report wire writer: auto|off",
+    "REPORTER_TPU_SERVICE_PROCS": "pre-fork service worker count",
     "REPORTER_TPU_SHARD": "multi-device mesh decode on/off",
     "REPORTER_TPU_SEQ_SHARDS": "sequence-parallel time-axis shards",
     "REPORTER_TPU_COORDINATOR": "jax.distributed rendezvous address",
@@ -92,6 +94,16 @@ METRICS: Dict[str, str] = {
     "route.cache.pair_misses": "route cache: pair-level misses",
     # service
     "service.requests": "/report requests",
+    # native wire writer (service/wire.py)
+    "wire.native": "responses emitted by the C-level writer",
+    "wire.fallback": "responses served by the Python columnar writer",
+    "wire.errors": "native writer faults (degraded to Python, not 500)",
+    "wire.circuit.*": "wire-writer breaker transitions/probes",
+    # pre-fork supervisor (service/prefork.py)
+    "service.procs.spawned": "worker processes forked at startup",
+    "service.procs.deaths": "worker exits outside shutdown",
+    "service.procs.restarts": "workers restarted into their slot",
+    "service.procs.worker_start": "per-worker post-fork service builds",
     "service.requests.histogram": "/histogram requests",
     "service.handle": "/report handling (timer)",
     "service.histogram": "/histogram handling (timer)",
@@ -174,6 +186,7 @@ FAULT_SITES: Dict[str, str] = {
     "state.save": "snapshot failure -> degraded (wider replay window)",
     "worker.offer": "crash at an exact stream position",
     "worker.post_egress": "crash between sink ack and epoch marker",
+    "wire.native": "native wire-writer fault -> Python writer, same bytes",
 }
 
 # ---- durable layout roots --------------------------------------------------

@@ -116,21 +116,34 @@ class RunColumns:
     tolist calls per 512-trace chunk."""
 
     __slots__ = ("seg_id", "internal", "start", "end", "length", "queue",
-                 "begin_idx", "end_idx", "way_off", "ways")
+                 "begin_idx", "end_idx", "way_off", "ways", "arrays")
 
     def __init__(self, runs: dict):
         self.seg_id = runs["seg_id"].tolist()
         self.internal = runs["internal"].astype(bool).tolist()
         # round HERE, whole column at once (reporter-lint HP002 sweep:
         # the dict-era formatter called round() twice per run)
-        self.start = np.round(runs["start"], 3).tolist()
-        self.end = np.round(runs["end"], 3).tolist()
+        start_r = np.round(runs["start"], 3)
+        end_r = np.round(runs["end"], 3)
+        self.start = start_r.tolist()
+        self.end = end_r.tolist()
         self.length = runs["length"].tolist()
         self.queue = runs["queue"].tolist()
         self.begin_idx = runs["begin_idx"].tolist()
         self.end_idx = runs["end_idx"].tolist()
         self.way_off = runs["way_off"].tolist()
         self.ways = runs["ways"].tolist()
+        # the same columns as numpy arrays (start/end already rounded),
+        # in the native wire writer's column order — rt_report_json /
+        # rt_render_segments_json serialise straight from these buffers
+        # (service/wire.py); hand-built RunColumns-shaped test doubles
+        # without this attribute take the Python writer path
+        self.arrays = {
+            "seg_id": runs["seg_id"], "internal": runs["internal"],
+            "start": start_r, "end": end_r, "length": runs["length"],
+            "queue": runs["queue"], "begin_idx": runs["begin_idx"],
+            "end_idx": runs["end_idx"], "way_off": runs["way_off"],
+            "ways": runs["ways"]}
 
 
 def _jnum(x) -> str:
@@ -156,15 +169,32 @@ def _jnum(x) -> str:
 
 def render_segments_json(cols: RunColumns, lo: int, hi: int,
                          mode: str) -> str:
-    """Serialise run columns [lo, hi) straight to the reference-schema
-    ``{"segments":[...],"mode":...}`` JSON — byte-identical to
-    ``json.dumps`` over the per-run dicts the old ``_format_runs``
-    materialised (pinned by tests/test_report_writer.py). This is the
-    columnar response writer: the hot serving path emits bytes from the
-    columns and never builds a per-run dict. Start/end times are always
-    finite floats here (rounded probe epochs / -1.0 sentinels), so they
-    format through bare ``repr`` — identical bytes to json.dumps's
-    ``float.__repr__`` path, without the per-value type dispatch."""
+    """Serialise run columns [lo, hi) to the reference-schema
+    ``{"segments":[...],"mode":...}`` JSON — a thin dispatcher over the
+    wire backend knob (``REPORTER_TPU_WIRE_NATIVE``): the C-level
+    writer (native/src/host_runtime.cpp rt_render_segments_json) when
+    armed and the columns carry their arrays, else the Python columnar
+    writer below. Both are byte-identical to ``json.dumps`` over the
+    per-run dicts the old ``_format_runs`` materialised (pinned by
+    tests/test_report_writer.py)."""
+    arrays = getattr(cols, "arrays", None)
+    if arrays is not None:
+        from ..service import wire
+        out = wire.maybe_native_segments(arrays, lo, hi, mode)
+        if out is not None:
+            return bytes(out).decode("utf-8")
+    return render_segments_json_py(cols, lo, hi, mode)
+
+
+def render_segments_json_py(cols: RunColumns, lo: int, hi: int,
+                            mode: str) -> str:
+    """The Python columnar segments writer — the wire dispatcher's
+    fallback backend, and the oracle the native writer is pinned
+    against. Emits bytes from the columns and never builds a per-run
+    dict. Start/end times are always finite floats here (rounded probe
+    epochs / -1.0 sentinels), so they format through bare ``repr`` —
+    identical bytes to json.dumps's ``float.__repr__`` path, without
+    the per-value type dispatch."""
     way_off, ways = cols.way_off, cols.ways
     start, end, length = cols.start, cols.end, cols.length
     queue, internal = cols.queue, cols.internal
@@ -669,6 +699,19 @@ class SegmentMatcher:
                             turn_penalty_factor=gp.turn_penalty_factor)
                         ro = runs["run_off"].tolist()
                         cols = RunColumns(runs)
+                        # chunk wire layout for the batch writer
+                        # (native.write_report_json_batch): per-trace
+                        # run spans + last point times, so the FIRST
+                        # /report serialisation of this chunk can emit
+                        # every trace's body in one C call and the
+                        # rest slice it (service/wire.py memo)
+                        pt_off = np.ascontiguousarray(batch.pt_off,
+                                                      dtype=np.int64)
+                        cols.arrays["_run_off"] = np.ascontiguousarray(
+                            runs["run_off"], dtype=np.int64)
+                        cols.arrays["_trace_end"] = np.ascontiguousarray(
+                            np.asarray(batch.times_flat,
+                                       dtype=np.float64)[pt_off[1:] - 1])
                         for b, i in enumerate(order):
                             results[i] = MatchRuns(
                                 cols, ro[b], ro[b + 1],

@@ -31,7 +31,7 @@ _LIB_PATH = os.path.join(_DIR, "libreporter_host.so")
 # Must equal host_runtime.cpp's rt_abi_version(). The handshake in
 # _get_lib() turns a half-landed ABI change (library and binding updated
 # in different commits) into a loud numpy fallback instead of a segfault.
-ABI_VERSION = 11
+ABI_VERSION = 12
 _lib = None
 # long_hold_ok: the once-only init hold (subprocess make + ABI
 # handshake, bounded by the 180 s build timeout) is the design — both
@@ -164,6 +164,33 @@ def _init_locked() -> Optional[ctypes.CDLL]:
             ctypes.c_int32,
             c_i32p, c_f32p, c_f32p, c_f32p, c_f32p, c_i32p, c_i32p, c_i32p,
             c_f32p, c_u8p, c_f32p, c_i64p]
+        # columnar /report wire writer (ABI 12): pure functions over
+        # borrowed run columns, no handle — see write_report_json below.
+        # The ten column base addresses travel as ONE packed int64
+        # array (built and cached per chunk by _writer_args), and every
+        # pointer binds as raw c_void_p: these are per-TRACE calls over
+        # a chunk-shared RunColumns, and ndpointer's per-call
+        # from_param validation of 10 arrays — then even ten plain
+        # pointer conversions — cost more than the serialisation
+        # itself (measured 2x the Python writer before the repack)
+        lib.rt_json_double.restype = ctypes.c_int64
+        lib.rt_json_double.argtypes = [ctypes.c_double, c_u8p]
+        lib.rt_render_segments_json.restype = ctypes.c_int64
+        lib.rt_render_segments_json.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_void_p,
+            ctypes.c_int64]
+        lib.rt_report_json.restype = ctypes.c_int64
+        lib.rt_report_json.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_double, ctypes.c_double, ctypes.c_int32,
+            ctypes.c_int32, ctypes.c_void_p, ctypes.c_int64]
+        lib.rt_report_json_batch.restype = ctypes.c_int64
+        lib.rt_report_json_batch.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_int64, ctypes.c_double, ctypes.c_int32,
+            ctypes.c_int32, ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_void_p]
         i64ref = ctypes.POINTER(ctypes.c_int64)
         lib.rt_tile_counts.restype = ctypes.c_int32
         lib.rt_tile_counts.argtypes = [
@@ -222,6 +249,127 @@ def available() -> bool:
     return _get_lib() is not None
 
 
+# ---- columnar /report wire writer (ABI 12) --------------------------------
+# Free functions over a chunk's run-column arrays (matcher.RunColumns
+# .arrays) — no graph handle, no shared state; ctypes releases the GIL,
+# so concurrent request threads serialise responses truly in parallel.
+
+_WRITER_COLS = ("seg_id", "internal", "start", "end", "length", "queue",
+                "begin_idx", "end_idx", "way_off", "ways")
+#: the wire ABI's expected dtypes, column-for-column with _WRITER_COLS
+_WIRE_DTYPES = (np.int64, np.uint8, np.float64, np.float64, np.int32,
+                np.int32, np.int32, np.int32, np.int64, np.int64)
+
+
+def _writer_args(arrays: dict) -> tuple:
+    """Per-chunk wire-call state, cached ON the arrays dict: every
+    trace in a chunk serialises from the same chunk-wide RunColumns,
+    so dtype/contiguity coercion AND pointer packing happen once per
+    CHUNK here, not once per trace in ctypes marshalling (which made
+    the C writer 2x slower than the Python one). Returns
+    ``(col_addrs_ptr, way_off_list)``: the address of a packed int64
+    array of the ten column base addresses (the C side's
+    ``unpack_cols`` order) and the way-offset column as a plain list
+    for the O(1) buffer sizing in the callers. The coerced arrays ride
+    along in the cache entry so the pointers stay alive."""
+    cached = arrays.get("_wire_ptrs")
+    if cached is None:
+        cols = tuple(np.ascontiguousarray(arrays[k], dtype=dt)
+                     for k, dt in zip(_WRITER_COLS, _WIRE_DTYPES))
+        addrs = np.array([c.ctypes.data for c in cols], dtype=np.int64)
+        cached = (addrs.ctypes.data, cols[8].tolist(), cols, addrs)
+        arrays["_wire_ptrs"] = cached
+    return cached
+
+
+def json_double(v: float) -> bytes:
+    """repr(float) bytes from the native writer — the formatting-parity
+    test surface (tests/test_report_writer.py pins it against repr)."""
+    lib = _get_lib()
+    if lib is None:
+        raise RuntimeError("native host runtime unavailable")
+    out = np.empty(32, np.uint8)
+    n = int(lib.rt_json_double(float(v), out))
+    return out[:n].tobytes()
+
+
+def write_segments_json(arrays: dict, lo: int, hi: int,
+                        mode_json: bytes) -> memoryview:
+    """``{"segments":[...],"mode":...}`` bytes for run columns [lo, hi)
+    — byte-identical to matcher.render_segments_json (pinned)."""
+    lib = _get_lib()
+    if lib is None:
+        raise RuntimeError("native host runtime unavailable")
+    col_addrs, way_off = _writer_args(arrays)[:2]
+    # generous first-try buffer: fixed keys + digits per run and per
+    # way id, grown on the (rare) -1 retry below
+    cap = 320 * (hi - lo + 1) + 24 * (way_off[hi] - way_off[lo]) + 1024
+    fn = lib.rt_render_segments_json
+    while True:
+        out = np.empty(cap, np.uint8)
+        n = fn(col_addrs, lo, hi, mode_json, len(mode_json),
+               out.ctypes.data, cap)
+        if n >= 0:
+            return out.data[:n]
+        cap *= 4
+
+
+def write_report_json_batch(arrays: dict, threshold_sec: float,
+                            report_mask: int, transition_mask: int):
+    """The whole CHUNK's /report bodies in ONE C call and one
+    contiguous buffer. Needs the chunk layout the batched assembler
+    attaches to its RunColumns (``_run_off``: per-trace run spans,
+    ``_trace_end``: per-trace last point times); returns ``(buffer,
+    offsets)`` where trace ``t``'s body is ``buffer[offsets[t]:
+    offsets[t+1]]`` — the per-trace slicing the parity tests pin
+    against the per-trace writer byte-for-byte."""
+    lib = _get_lib()
+    if lib is None:
+        raise RuntimeError("native host runtime unavailable")
+    run_off = arrays["_run_off"]
+    trace_ends = arrays["_trace_end"]
+    n = len(run_off) - 1
+    col_addrs, way_off = _writer_args(arrays)[:2]
+    offsets = np.empty(n + 1, np.int64)
+    # size from the meaningful prefixes ONLY: the assembler over-
+    # allocates the way_off column to the ways capacity, so entries
+    # past run_off[-1] are uninitialised — way_off[-1] is garbage
+    n_runs = int(run_off[-1])
+    cap = 320 * (n_runs + n) + 24 * way_off[n_runs] + 448 * n + 1024
+    fn = lib.rt_report_json_batch
+    while True:
+        out = np.empty(cap, np.uint8)
+        total = fn(col_addrs, run_off.ctypes.data,
+                   trace_ends.ctypes.data, n, threshold_sec,
+                   report_mask, transition_mask, out.ctypes.data, cap,
+                   offsets.ctypes.data)
+        if total >= 0:
+            return out, offsets.tolist()
+        cap *= 4
+
+
+def write_report_json(arrays: dict, lo: int, hi: int, trace_end: float,
+                      threshold_sec: float, report_mask: int,
+                      transition_mask: int) -> memoryview:
+    """The whole /report response body for run columns [lo, hi) in ONE
+    contiguous caller-owned buffer — byte-identical to
+    service.report.report_json (pinned). The returned memoryview goes
+    to the socket with no re-encode (service/server.py)."""
+    lib = _get_lib()
+    if lib is None:
+        raise RuntimeError("native host runtime unavailable")
+    col_addrs, way_off = _writer_args(arrays)[:2]
+    cap = 320 * (hi - lo + 1) + 24 * (way_off[hi] - way_off[lo]) + 1024
+    fn = lib.rt_report_json
+    while True:
+        out = np.empty(cap, np.uint8)
+        n = fn(col_addrs, lo, hi, trace_end, threshold_sec,
+               report_mask, transition_mask, out.ctypes.data, cap)
+        if n >= 0:
+            return out.data[:n]
+        cap *= 4
+
+
 class NativeRuntime:
     """C++-backed candidate lookup + route matrices for one RoadNetwork.
 
@@ -235,6 +383,13 @@ class NativeRuntime:
             raise RuntimeError("native host runtime unavailable")
         self._lib = lib
         self.net = net
+        # fork guard: the handle's C++ WorkerPool threads (and any mid-
+        # call state) do NOT survive os.fork() — a forked child calling
+        # through an inherited handle would hang on a condvar no thread
+        # will ever signal. _check_owner turns that hang into a loud
+        # error the matcher's circuit breaker degrades around; pre-fork
+        # serving (service/prefork.py) builds its runtimes post-fork.
+        self._owner_pid = os.getpid()
         # rt_graph_create copies everything into C++ vectors, so the
         # contiguous staging arrays only need to live for this call
         nx, ny = net.node_xy()
@@ -251,15 +406,28 @@ class NativeRuntime:
     def __del__(self):
         try:
             if getattr(self, "_handle", None):
-                self._lib.rt_graph_destroy(self._handle)
+                # never destroy a parent's handle from a forked child:
+                # the pool threads the destructor joins exist only in
+                # the owning process (the child would hang; the memory
+                # is the parent's to free)
+                if os.getpid() == getattr(self, "_owner_pid", os.getpid()):
+                    self._lib.rt_graph_destroy(self._handle)
                 self._handle = None
         except Exception:
             pass
+
+    def _check_owner(self) -> None:
+        if os.getpid() != self._owner_pid:
+            raise RuntimeError(
+                "NativeRuntime used across fork (its C++ worker-pool "
+                "threads did not survive); build a new SegmentMatcher "
+                "in the child process")
 
     # -- SpatialGrid-compatible candidate lookup ---------------------------
     def candidates(self, lat, lon, k: int, search_radius_m: float = 50.0):
         from ..graph.spatial import CandidateSet
 
+        self._check_owner()
         to_xy, _ = self.net.projection()
         px, py = to_xy(np.asarray(lat, dtype=np.float64),
                        np.asarray(lon, dtype=np.float64))
@@ -296,6 +464,7 @@ class NativeRuntime:
         between candidate edges. Semantics mirror
         graph.route.candidate_route_matrices exactly.
         """
+        self._check_owner()
         T, K = cands.edge_ids.shape
         out = np.empty((max(T - 1, 0), K, K), dtype=np.float32)
         if T < 2:
@@ -347,6 +516,7 @@ class NativeRuntime:
         copy anywhere on the path (parallel/sharded.py; the decode
         kernels slice the dead step off inside jit).
         """
+        self._check_owner()
         pt_off = np.ascontiguousarray(pt_off, dtype=np.int64)
         lat = np.ascontiguousarray(lat, dtype=np.float64)
         lon = np.ascontiguousarray(lon, dtype=np.float64)
@@ -468,6 +638,7 @@ class NativeRuntime:
         reference-schema segment dicts (matcher/assemble.py semantics,
         pinned by parity tests).
         """
+        self._check_owner()
         cols = self._assembly_columns()
         path = np.ascontiguousarray(path, dtype=np.int32)
         B, T = path.shape

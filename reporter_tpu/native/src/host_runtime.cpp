@@ -964,7 +964,7 @@ extern "C" {
 // numpy path loudly instead of calling through a stale signature. BUMP
 // THIS on ANY change to the signatures below, in the same commit as the
 // Python-side constant.
-int32_t rt_abi_version(void) { return 11; }
+int32_t rt_abi_version(void) { return 12; }
 
 void* rt_graph_create(int64_t n_nodes, int64_t n_edges,
                       const double* node_x, const double* node_y,
@@ -1749,6 +1749,555 @@ int64_t rt_assemble_batch(
   }
   run_off[B] = r_total;
   return r_total;
+}
+
+}  // extern "C"
+
+// ---- columnar /report wire writer (ABI 12) -------------------------------
+// Emits the whole /report UTF-8 JSON response for one trace's run-column
+// slice [lo, hi) into a single caller-owned buffer — the native twin of
+// service/report.py's Python columnar writer, pinned byte-identical to it
+// (and therefore to json.dumps over the legacy dict path) by
+// tests/test_report_writer.py. Pure functions over borrowed numpy columns:
+// no handle, no allocation, no shared state — concurrent calls from many
+// GIL-released request threads are trivially safe (TSan leg drives them).
+
+namespace jsonwire {
+
+inline char* put_u64_dec(char* p, uint64_t v) {
+  char tmp[20];
+  int n = 0;
+  do {
+    tmp[n++] = static_cast<char>('0' + v % 10);
+    v /= 10;
+  } while (v);
+  while (n) *p++ = tmp[--n];
+  return p;
+}
+
+inline char* put_i64_dec(char* p, int64_t v) {
+  uint64_t u = static_cast<uint64_t>(v);
+  if (v < 0) {
+    *p++ = '-';
+    u = 0ull - u;
+  }
+  return put_u64_dec(p, u);
+}
+
+// CPython round(x, 3): correctly-rounded DECIMAL rounding with ties to
+// even — NOT rint(x*1000)/1000 (that is numpy's np.round, which the
+// Python side applies to the start/end columns before they reach this
+// writer). glibc's printf is correctly rounded with the same tie rule,
+// so %.3f + strtod reproduces the builtin bit-for-bit. Magnitudes past
+// 1e13 are already coarser than 1e-3 (ulp > 2e-3): round() returns the
+// input there, and the guard also bounds the %.3f output length.
+inline double py_round3(double x) {
+  if (!std::isfinite(x) || std::fabs(x) >= 1e13) return x;
+  char buf[64];
+  snprintf(buf, sizeof buf, "%.3f", x);
+  return strtod(buf, nullptr);
+}
+
+// Python float-repr formatting over extracted digits: dig[0..p) with the
+// first digit worth 10^e. Mirrors CPython's format_float_short: fixed
+// notation for -4 <= e < 16 (integer values gain ".0"), scientific
+// otherwise with a sign and >= 2 exponent digits.
+inline int format_repr(bool neg, const char* dig, int p, int e,
+                       char* out) {
+  char* q = out;
+  if (neg) *q++ = '-';
+  if (-4 <= e && e < 16) {
+    if (e >= p - 1) {
+      std::memcpy(q, dig, p);
+      q += p;
+      for (int i = 0; i < e - (p - 1); ++i) *q++ = '0';
+      *q++ = '.';
+      *q++ = '0';
+    } else if (e >= 0) {
+      std::memcpy(q, dig, e + 1);
+      q += e + 1;
+      *q++ = '.';
+      std::memcpy(q, dig + e + 1, p - e - 1);
+      q += p - e - 1;
+    } else {
+      *q++ = '0';
+      *q++ = '.';
+      for (int i = 0; i < -e - 1; ++i) *q++ = '0';
+      std::memcpy(q, dig, p);
+      q += p;
+    }
+  } else {
+    *q++ = dig[0];
+    if (p > 1) {
+      *q++ = '.';
+      std::memcpy(q, dig + 1, p - 1);
+      q += p - 1;
+    }
+    *q++ = 'e';
+    *q++ = e < 0 ? '-' : '+';
+    int a = e < 0 ? -e : e;
+    if (a < 10) *q++ = '0';  // repr pads the exponent to two digits
+    q = put_u64_dec(q, static_cast<uint64_t>(a));
+  }
+  return static_cast<int>(q - out);
+}
+
+// repr(float) bytes, CPython-identical, with json.dumps's Infinity/NaN
+// spellings (matcher._jnum). `out` must hold >= 32 bytes. Two fast
+// paths cover every value this wire actually carries (integer-valued
+// doubles and 3-decimal-rounded times/kms below 1e12, where a
+// round-tripping stripped "%.3f" is provably the shortest repr); the
+// general path finds the smallest precision whose correctly-rounded
+// "%.*e" round-trips — the grisu-style shortest-digits contract,
+// delegated to glibc's correctly-rounded conversions.
+inline int json_double(double v, char* out) {
+  if (std::isnan(v)) {
+    std::memcpy(out, "NaN", 3);
+    return 3;
+  }
+  if (std::isinf(v)) {
+    if (v < 0) {
+      std::memcpy(out, "-Infinity", 9);
+      return 9;
+    }
+    std::memcpy(out, "Infinity", 8);
+    return 8;
+  }
+  const bool neg = std::signbit(v);
+  const double a = neg ? -v : v;
+  char* q = out;
+  if (a == 0.0) {
+    if (neg) *q++ = '-';
+    *q++ = '0';
+    *q++ = '.';
+    *q++ = '0';
+    return static_cast<int>(q - out);
+  }
+  if (a < 1e16 && a == std::floor(a)) {
+    if (neg) *q++ = '-';
+    q = put_u64_dec(q, static_cast<uint64_t>(a));
+    *q++ = '.';
+    *q++ = '0';
+    return static_cast<int>(q - out);
+  }
+  char buf[40];
+  if (a < 1e12) {
+    // 3-decimal fast path: below 1e12 a double's half-ulp is < 5e-4,
+    // so at most one 3-decimal string round-trips and no shorter
+    // string can (beyond trailing-zero stripping) — if the 3-decimal
+    // form round-trips, it IS repr. All in integer math: m is the
+    // correctly-rounded (ties-even, llrint) milli-value, and
+    // double(m)/1000.0 — one exact int64->double conversion, one
+    // correctly-rounded division — equals strtod of the 3-decimal
+    // string by IEEE-754, so the snprintf/strtod pair this path used
+    // to lean on (~2 us per float, most of the writer's wall) is
+    // byte-for-byte replaced by a division and a compare.
+    const int64_t m = std::llrint(a * 1000.0);
+    if (m > 0 && static_cast<double>(m) / 1000.0 == a) {
+      if (neg) *q++ = '-';
+      q = put_u64_dec(q, static_cast<uint64_t>(m / 1000));
+      // m % 1000 > 0: an integer-valued a took the floor path above
+      const int frac = static_cast<int>(m % 1000);
+      const char d2 = static_cast<char>('0' + frac / 100);
+      const char d1 = static_cast<char>('0' + (frac / 10) % 10);
+      const char d0 = static_cast<char>('0' + frac % 10);
+      *q++ = '.';
+      *q++ = d2;
+      if (d1 != '0' || d0 != '0') *q++ = d1;
+      if (d0 != '0') *q++ = d0;
+      return static_cast<int>(q - out);
+    }
+  }
+  // general path (rare on this wire): smallest p in 1..17 whose
+  // correctly-rounded p-digit form round-trips = shortest repr digits
+  int p = 17;
+  for (int t = 1; t <= 17; ++t) {
+    snprintf(buf, sizeof buf, "%.*e", t - 1, a);
+    if (strtod(buf, nullptr) == a) {
+      p = t;
+      break;
+    }
+  }
+  snprintf(buf, sizeof buf, "%.*e", p - 1, a);
+  char dig[20];
+  int np = 0;
+  const char* s = buf;
+  dig[np++] = *s++;
+  // collect mantissa digits up to 'e', skipping the radix mark
+  // WHATEVER the host process's LC_NUMERIC renders it as (an embedding
+  // application may have setlocale'd to a comma — or multibyte —
+  // decimal point; the strtod round-trip checks above formatted and
+  // parsed under that same locale, so they stay self-consistent, and
+  // the emitted JSON gets its '.' from format_repr, never from here)
+  while (*s != 'e') {
+    if (*s >= '0' && *s <= '9') dig[np++] = *s;
+    ++s;
+  }
+  ++s;  // 'e'
+  const int esign = (*s++ == '-') ? -1 : 1;
+  int e = 0;
+  while (*s) e = e * 10 + (*s++ - '0');
+  e *= esign;
+  while (np > 1 && dig[np - 1] == '0') --np;  // belt + braces
+  return format_repr(neg, dig, np, e, out);
+}
+
+// Bounds-checked append buffer: overflow latches `of` and stops writing;
+// the caller grows its buffer and retries (returns -1 at the ABI edge).
+struct JBuf {
+  char* p;
+  int64_t cap;
+  int64_t n = 0;
+  bool of = false;
+  void raw(const void* s, int64_t k) {
+    if (of || n + k > cap) {
+      of = true;
+      return;
+    }
+    std::memcpy(p + n, s, k);
+    n += k;
+  }
+  template <size_t N>
+  void lit(const char (&s)[N]) {
+    raw(s, static_cast<int64_t>(N - 1));
+  }
+  void ch(char c) {
+    if (of || n + 1 > cap) {
+      of = true;
+      return;
+    }
+    p[n++] = c;
+  }
+  void i64(int64_t v) {
+    char t[24];
+    raw(t, put_i64_dec(t, v) - t);
+  }
+  void f(double v) {
+    char t[40];
+    raw(t, json_double(v, t));
+  }
+};
+
+// matcher.render_segments_json: the reference-schema
+// {"segments":[...],"mode":...} block straight from run columns.
+inline void render_segments(JBuf& b, const int64_t* seg_id,
+                            const uint8_t* internal, const double* start,
+                            const double* end_, const int32_t* length,
+                            const int32_t* queue, const int32_t* begin_idx,
+                            const int32_t* end_idx, const int64_t* way_off,
+                            const int64_t* ways, int64_t lo, int64_t hi,
+                            const char* mode_json, int64_t mode_len) {
+  b.lit("{\"segments\":[");
+  for (int64_t r = lo; r < hi; ++r) {
+    if (r > lo) b.ch(',');
+    b.lit("{\"way_ids\":[");
+    for (int64_t w = way_off[r]; w < way_off[r + 1]; ++w) {
+      if (w > way_off[r]) b.ch(',');
+      b.i64(ways[w]);
+    }
+    b.lit("],\"start_time\":");
+    b.f(start[r]);
+    b.lit(",\"end_time\":");
+    b.f(end_[r]);
+    b.lit(",\"length\":");
+    b.i64(length[r]);
+    b.lit(",\"queue_length\":");
+    b.i64(queue[r]);
+    b.lit(",\"internal\":");
+    if (internal[r])
+      b.lit("true");
+    else
+      b.lit("false");
+    b.lit(",\"begin_shape_index\":");
+    b.i64(begin_idx[r]);
+    b.lit(",\"end_shape_index\":");
+    b.i64(end_idx[r]);
+    if (seg_id[r] >= 0) {
+      b.lit(",\"segment_id\":");
+      b.i64(seg_id[r]);
+    }
+    b.ch('}');
+  }
+  b.lit("],\"mode\":");
+  b.raw(mode_json, mode_len);
+  b.ch('}');
+}
+
+struct ScanStats {
+  int64_t successful = 0, unreported = 0;
+  double successful_km = 0.0, unreported_km = 0.0;
+  int64_t discontinuities = 0, invalid_times = 0, invalid_speeds = 0,
+          unassociated = 0;
+  int64_t last_idx = -1;    // relative to lo
+  int64_t shape_used = -1;  // -1 = None (omitted)
+};
+
+// The reference's pairwise emission state machine — a line-for-line
+// port of service/report.py _scan_segments over the ROUNDED columns
+// (the Python side applies np.round(.., 3) before handing them over,
+// so holdback comparisons and emitted bytes see identical doubles).
+// With `emit` set, report objects stream into it; the machine runs
+// twice per response — once to size the stats block that precedes the
+// reports, once to emit — so the caller must hand the second pass a
+// throwaway ScanStats (the km sums accumulate per pass).
+inline void scan_segments(const int64_t* seg_id, const uint8_t* internal,
+                          const double* start, const double* end_,
+                          const int32_t* length, const int32_t* queue,
+                          const int32_t* begin_idx, const int32_t* end_idx,
+                          int64_t lo, int64_t hi, double trace_end,
+                          double threshold_sec, uint32_t report_mask,
+                          uint32_t transition_mask, ScanStats* st,
+                          JBuf* emit) {
+  const int64_t n = hi - lo;
+  int64_t last = n - 1;
+  while (last >= 0 && trace_end - start[lo + last] < threshold_sec) --last;
+  st->last_idx = last;
+  if (last > 0)
+    st->shape_used = end_idx[lo + last - 1];
+  else if (last == 0)
+    st->shape_used = std::max<int64_t>(
+        static_cast<int64_t>(begin_idx[lo]) - 1, 0);
+  bool have_pending = false, first = true, emitted_any = false;
+  bool p_has_sid = false;
+  int64_t p_sid = 0;
+  double p_start = 0.0, p_end = 0.0;
+  int32_t p_len = 0, p_queue = 0;
+  int p_level = -1;
+  for (int64_t i = 0; i <= last; ++i) {
+    const int64_t sid = seg_id[lo + i];
+    const bool has_sid = sid >= 0;  // -1 = column sentinel for no id
+    const bool intern = internal[lo + i] != 0;
+    const double start_time = start[lo + i];
+    if (i > 0 && start_time == -1.0 && end_[lo + i - 1] == -1.0)
+      ++st->discontinuities;
+    const int level = has_sid ? static_cast<int>(sid & 7) : -1;
+    if (have_pending && p_has_sid && p_len > 0 && !intern) {
+      if (p_level >= 0 && ((report_mask >> p_level) & 1u)) {
+        const bool trans =
+            level >= 0 && ((transition_mask >> level) & 1u);
+        const double t1 = trans ? start_time : p_end;
+        const double dt = t1 - p_start;
+        if (dt <= 0.0 || std::isinf(dt) || std::isnan(dt)) {
+          ++st->invalid_times;
+        } else if ((static_cast<double>(p_len) / dt) * 3.6 > 160.0) {
+          ++st->invalid_speeds;
+        } else {
+          ++st->successful;
+          // == py_round3(p_len * 0.001): for integer meters the
+          // 3-decimal rounding of len*0.001 is exactly the correctly-
+          // rounded division len/1000 (validated exhaustively against
+          // CPython round() in the parity tests) — no snprintf here
+          st->successful_km += static_cast<double>(p_len) / 1000.0;
+          if (emit) {
+            if (emitted_any) emit->ch(',');
+            emitted_any = true;
+            emit->lit("{\"id\":");
+            emit->i64(p_sid);
+            emit->lit(",\"t0\":");
+            emit->f(p_start);
+            emit->lit(",\"t1\":");
+            emit->f(t1);
+            emit->lit(",\"length\":");
+            emit->i64(p_len);
+            emit->lit(",\"queue_length\":");
+            emit->i64(p_queue);
+            if (trans && has_sid) {
+              emit->lit(",\"next_id\":");
+              emit->i64(sid);
+            }
+            emit->ch('}');
+          }
+        }
+      } else {
+        ++st->unreported;
+        st->unreported_km += static_cast<double>(p_len) / 1000.0;
+      }
+    }
+    if (!(intern && !first)) {
+      p_has_sid = has_sid;
+      p_sid = sid;
+      p_start = start_time;
+      p_end = end_[lo + i];
+      p_len = length[lo + i];
+      p_queue = queue[lo + i];
+      p_level = level;
+      have_pending = true;
+    }
+    first = false;
+    if (!has_sid && !intern) ++st->unassociated;
+  }
+}
+
+// One trace's column set, unpacked from the packed base-address array
+// the Python side caches per CHUNK (native._writer_args). Order is the
+// wire contract with _WRITER_COLS/_WIRE_DTYPES: [0]=seg_id(i64)
+// [1]=internal(u8) [2]=start(f64) [3]=end(f64) [4]=length(i32)
+// [5]=queue(i32) [6]=begin_idx(i32) [7]=end_idx(i32) [8]=way_off(i64)
+// [9]=ways(i64). Ten separate pointer params would be marshalled by
+// ctypes on EVERY per-trace call — measured at more than the
+// serialisation itself — so the addresses travel as one array whose
+// storage the caller owns for the duration of the call.
+struct WireCols {
+  const int64_t* seg_id;
+  const uint8_t* internal;
+  const double* start;
+  const double* end_;
+  const int32_t* length;
+  const int32_t* queue;
+  const int32_t* begin_idx;
+  const int32_t* end_idx;
+  const int64_t* way_off;
+  const int64_t* ways;
+};
+
+inline WireCols unpack_cols(const int64_t* a) {
+  return WireCols{reinterpret_cast<const int64_t*>(a[0]),
+                  reinterpret_cast<const uint8_t*>(a[1]),
+                  reinterpret_cast<const double*>(a[2]),
+                  reinterpret_cast<const double*>(a[3]),
+                  reinterpret_cast<const int32_t*>(a[4]),
+                  reinterpret_cast<const int32_t*>(a[5]),
+                  reinterpret_cast<const int32_t*>(a[6]),
+                  reinterpret_cast<const int32_t*>(a[7]),
+                  reinterpret_cast<const int64_t*>(a[8]),
+                  reinterpret_cast<const int64_t*>(a[9])};
+}
+
+}  // namespace jsonwire
+
+extern "C" {
+
+// repr(float) bytes into out (>= 32 bytes); returns the length. The
+// formatting-parity unit-test surface for the two writers below.
+int64_t rt_json_double(double v, uint8_t* out) {
+  return jsonwire::json_double(v, reinterpret_cast<char*>(out));
+}
+
+// {"segments":[...],"mode":<mode_json>} for run columns [lo, hi).
+// Returns bytes written, or -1 when cap is too small (caller grows and
+// retries). mode_json is the pre-encoded JSON token for the mode value.
+int64_t rt_render_segments_json(
+    const void* col_addrs, int64_t lo, int64_t hi,
+    const char* mode_json, int64_t mode_len, void* out, int64_t cap) {
+  const jsonwire::WireCols c = jsonwire::unpack_cols(
+      static_cast<const int64_t*>(col_addrs));
+  jsonwire::JBuf b{reinterpret_cast<char*>(out), cap};
+  jsonwire::render_segments(b, c.seg_id, c.internal, c.start, c.end_,
+                            c.length, c.queue, c.begin_idx, c.end_idx,
+                            c.way_off, c.ways, lo, hi, mode_json,
+                            mode_len);
+  return b.of ? -1 : b.n;
+}
+
+}  // extern "C"
+
+namespace jsonwire {
+
+// One trace's whole /report response body for run columns [lo, hi):
+// stats + optional shape_used + segment_matcher echo + datastore
+// reports, in service/report.py report_json's exact byte layout —
+// shared by the per-trace ABI call and the whole-chunk batch call.
+inline void emit_report(JBuf& b, const WireCols& c, int64_t lo,
+                        int64_t hi, double trace_end,
+                        double threshold_sec, uint32_t report_mask,
+                        uint32_t transition_mask) {
+  const int64_t* seg_id = c.seg_id;
+  const uint8_t* internal = c.internal;
+  const double* start = c.start;
+  const double* end_ = c.end_;
+  const int32_t* length = c.length;
+  const int32_t* queue = c.queue;
+  const int32_t* begin_idx = c.begin_idx;
+  const int32_t* end_idx = c.end_idx;
+  const int64_t* way_off = c.way_off;
+  const int64_t* ways = c.ways;
+  ScanStats st;
+  scan_segments(seg_id, internal, start, end_, length, queue,
+                begin_idx, end_idx, lo, hi, trace_end, threshold_sec,
+                report_mask, transition_mask, &st, nullptr);
+  b.lit("{\"stats\":{\"successful_matches\":{\"count\":");
+  b.i64(st.successful);
+  b.lit(",\"length\":");
+  b.f(jsonwire::py_round3(st.successful_km));
+  b.lit("},\"unreported_matches\":{\"count\":");
+  b.i64(st.unreported);
+  b.lit(",\"length\":");
+  b.f(jsonwire::py_round3(st.unreported_km));
+  b.lit("},\"match_errors\":{\"discontinuities\":");
+  b.i64(st.discontinuities);
+  b.lit(",\"invalid_speeds\":");
+  b.i64(st.invalid_speeds);
+  b.lit(",\"invalid_times\":");
+  b.i64(st.invalid_times);
+  b.lit("},\"unassociated_segments\":");
+  b.i64(st.unassociated);
+  b.ch('}');
+  if (st.shape_used > 0) {  // falsy-omitted, like report() (index 0 too)
+    b.lit(",\"shape_used\":");
+    b.i64(st.shape_used);
+  }
+  b.lit(",\"segment_matcher\":");
+  render_segments(b, seg_id, internal, start, end_, length, queue,
+                  begin_idx, end_idx, way_off, ways, lo, hi,
+                  "\"auto\"", 6);
+  b.lit(",\"datastore\":{\"mode\":\"auto\",\"reports\":[");
+  ScanStats st2;
+  scan_segments(seg_id, internal, start, end_, length, queue, begin_idx,
+                end_idx, lo, hi, trace_end, threshold_sec, report_mask,
+                transition_mask, &st2, &b);
+  b.lit("]}}");
+}
+
+}  // namespace jsonwire
+
+extern "C" {
+
+// One trace's /report body for run columns [lo, hi). Returns bytes
+// written, or -1 when cap is too small (caller grows and retries).
+// report/transition masks carry levels 0..7 as bits (level =
+// segment_id & 7).
+int64_t rt_report_json(
+    const void* col_addrs, int64_t lo, int64_t hi,
+    double trace_end, double threshold_sec, int32_t report_mask,
+    int32_t transition_mask, void* out, int64_t cap) {
+  const jsonwire::WireCols c = jsonwire::unpack_cols(
+      static_cast<const int64_t*>(col_addrs));
+  jsonwire::JBuf b{reinterpret_cast<char*>(out), cap};
+  jsonwire::emit_report(b, c, lo, hi, trace_end, threshold_sec,
+                        static_cast<uint32_t>(report_mask),
+                        static_cast<uint32_t>(transition_mask));
+  return b.of ? -1 : b.n;
+}
+
+// The whole CHUNK's /report bodies in one call and one contiguous
+// buffer: trace t (of n_traces, in run_off order) covers run columns
+// [run_off[t], run_off[t+1]) with its own trace_ends[t]; its body is
+// out[offsets[t], offsets[t+1]) — the per-trace slices the service
+// hands to sockets zero-copy (service/wire.py memoises the buffer per
+// chunk, so concurrent requests batched into one decode also share
+// ONE serialisation call). Returns total bytes, or -1 when cap is too
+// small (offsets[] contents are then unspecified; caller retries).
+int64_t rt_report_json_batch(
+    const void* col_addrs, const void* run_off_p,
+    const void* trace_ends_p, int64_t n_traces, double threshold_sec,
+    int32_t report_mask, int32_t transition_mask, void* out,
+    int64_t cap, void* offsets_p) {
+  const jsonwire::WireCols c = jsonwire::unpack_cols(
+      static_cast<const int64_t*>(col_addrs));
+  const int64_t* run_off = static_cast<const int64_t*>(run_off_p);
+  const double* trace_ends = static_cast<const double*>(trace_ends_p);
+  int64_t* offsets = static_cast<int64_t*>(offsets_p);
+  jsonwire::JBuf b{reinterpret_cast<char*>(out), cap};
+  for (int64_t t = 0; t < n_traces; ++t) {
+    offsets[t] = b.n;
+    jsonwire::emit_report(b, c, run_off[t], run_off[t + 1],
+                          trace_ends[t], threshold_sec,
+                          static_cast<uint32_t>(report_mask),
+                          static_cast<uint32_t>(transition_mask));
+    if (b.of) return -1;
+  }
+  offsets[n_traces] = b.n;
+  return b.n;
 }
 
 }  // extern "C"

@@ -178,3 +178,11 @@ def dump(reason: str, extra: Optional[dict] = None) -> Optional[str]:
 
 
 _configure_env()
+
+# fork safety: a forked worker's postmortem must carry ITS spans — the
+# inherited ring and open-span table describe work the parent did. The
+# dump-dir config survives (forked workers share the deployment's spool;
+# dump names are pid-qualified, so files never collide across workers).
+from ..utils import forksafe as _forksafe  # noqa: E402
+
+_forksafe.register(reset)

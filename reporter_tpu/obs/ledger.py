@@ -120,16 +120,38 @@ def _failed_entry(source: str, label: str, kind: str, tail: str) -> dict:
 
 
 def _multichip_entry(source: str, d: dict) -> dict:
+    """One ledger entry from a MULTICHIP artifact. Legacy artifacts
+    carry only the liveness verdict; tools/multichip_bench.py ones add
+    per-device-count legs and throughput ratios — ``vs_baseline`` then
+    holds the max-device-count ratio over the 1-device leg (a true
+    same-box ratio, like every other entry) and ``traces_per_sec`` the
+    max-device leg's absolute, with the full ratio curve in context.
+    Gate with ``tools/perf_gate.py --multichip`` (the kind is excluded
+    from the bench comparable pool, so these ratios never bleed into
+    the vs_baseline medians)."""
+    ratios = d.get("ratios") or {}
+    legs = d.get("legs") or []
+    top = max((leg for leg in legs
+               if leg.get("traces_per_sec")),
+              key=lambda leg: leg["n_devices"], default=None)
+    vs = ratios.get(str(d.get("n_devices"))) if ratios else None
+    context = None
+    if ratios:
+        context = "device ratios vs 1: " + ",".join(
+            f"{k}x={v}" for k, v in sorted(ratios.items(),
+                                           key=lambda kv: int(kv[0])))
+    elif not d.get("ok"):
+        context = f"rc={d.get('rc')}; harness leg failed or timed out"
     return {"source": source,
             "label": source.replace("MULTICHIP_", "").replace(".json",
                                                               ""),
             "kind": "multichip", "scope": "full",
             "platform": None, "decode": None, "pipelined": None,
-            "vs_baseline": None, "traces_per_sec": None,
+            "vs_baseline": vs,
+            "traces_per_sec": top["traces_per_sec"] if top else None,
             "baseline_tps": None, "stage_shares": None,
             "n_devices": d.get("n_devices"), "ok": bool(d.get("ok")),
-            "context": None if d.get("ok")
-            else f"rc={d.get('rc')}; harness leg failed or timed out"}
+            "context": context}
 
 
 def seed_entries(repo: str) -> List[dict]:
@@ -137,7 +159,7 @@ def seed_entries(repo: str) -> List[dict]:
     entries: List[dict] = []
 
     # driver rounds: {"n", "cmd", "rc", "tail", "parsed"}
-    for path in sorted(glob.glob(os.path.join(repo, "BENCH_r0*.json"))):
+    for path in sorted(glob.glob(os.path.join(repo, "BENCH_r*.json"))):
         name = os.path.basename(path)
         label = name.replace("BENCH_", "").replace(".json", "")
         with open(path, encoding="utf-8") as f:
@@ -176,24 +198,37 @@ def seed_entries(repo: str) -> List[dict]:
                     d[leg], "BENCH_DEV_r04_tpu.json", f"dev_r04_{leg}",
                     "bench_dev", context=note))
 
-    dev6 = os.path.join(repo, "BENCH_DEV_r06.json")
-    if os.path.exists(dev6):
-        with open(dev6, encoding="utf-8") as f:
+    # r06 onward share one shape: {"parsed": <bench artifact>,
+    # "serialized_breakdown": {"value", "stages"}, "context": {"box"}}
+    # — two entries per file: the pipelined headline and the
+    # serialized stage breakdown (whose ratio shares the parsed leg's
+    # baseline run — same box, so it is the r05-comparable number)
+    for path in sorted(glob.glob(os.path.join(repo,
+                                              "BENCH_DEV_r*.json"))):
+        name = os.path.basename(path)
+        label_n = name.replace("BENCH_DEV_", "").replace(".json", "")
+        if label_n in ("r04", "r04_tpu"):
+            continue  # the heterogeneous legacy shapes handled above
+        with open(path, encoding="utf-8") as f:
             d = json.load(f)
         box_note = (d.get("context") or {}).get("box")
         if d.get("parsed"):
             entries.append(entry_from_bench(
-                d["parsed"], "BENCH_DEV_r06.json", "dev_r06",
-                "bench_dev", context=box_note))
+                d["parsed"], name, f"dev_{label_n}", "bench_dev",
+                context=box_note))
         ser = d.get("serialized_breakdown") or {}
         parsed = d.get("parsed") or {}
         base = (parsed.get("baseline") or {}).get("traces_per_sec")
         if ser.get("value") and base:
-            # the serialized leg shares the parsed leg's baseline run;
-            # its ratio is derivable and IS the r05-comparable number
-            entries.append({
-                "source": "BENCH_DEV_r06.json",
-                "label": "dev_r06_serialized",
+            shares = stage_shares(ser.get("stages"))
+            if shares and "report-serialise" not in \
+                    (parsed.get("metric") or ""):
+                shares.pop("report", None)  # pre-PR-4 report scope
+            # a handful of checked-in artifacts at seed time, not a
+            # serving path
+            entries.append({  # lint: ignore[HP002]
+                "source": name,
+                "label": f"dev_{label_n}_serialized",
                 "kind": "bench_dev",
                 "scope": "full",
                 "platform": "cpu", "decode": "scan",
@@ -201,14 +236,14 @@ def seed_entries(repo: str) -> List[dict]:
                 "vs_baseline": round(ser["value"] / base, 2),
                 "traces_per_sec": ser["value"],
                 "baseline_tps": base,
-                "stage_shares": stage_shares(ser.get("stages")),
+                "stage_shares": shares,
                 "n_devices": None, "ok": True,
                 "context": box_note,
             })
 
     # multichip harness verdicts: {"n_devices", "rc", "ok", ...}
     for path in sorted(glob.glob(os.path.join(repo,
-                                              "MULTICHIP_r0*.json"))):
+                                              "MULTICHIP_r*.json"))):
         with open(path, encoding="utf-8") as f:
             d = json.load(f)
         entries.append(_multichip_entry(os.path.basename(path), d))

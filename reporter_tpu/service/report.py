@@ -241,11 +241,69 @@ def report(match: dict, trace: dict, threshold_sec: float,
     return out
 
 
+_wire_mod = None
+
+
+def _wire():
+    """service.wire, bound once (like :func:`_matcher`: a per-call
+    ``from`` import costs importlib machinery on every request)."""
+    global _wire_mod
+    if _wire_mod is None:
+        from . import wire as _wire_mod_  # noqa: F401
+        _wire_mod = _wire_mod_
+    return _wire_mod
+
+
+def _try_native_wire(match, trace: dict, threshold_sec: float,
+                     report_levels, transition_levels):
+    """The C-level writer's bytes for a MatchRuns, or None (backend
+    off / circuit open / writer fault — the caller falls back to the
+    Python columnar writer, byte-identical)."""
+    arrays = getattr(match.cols, "arrays", None)
+    if arrays is None:
+        return None
+    wire = _wire_mod if _wire_mod is not None else _wire()
+    out = wire.maybe_native_report(
+        arrays, match.lo, match.hi, trace["trace"][-1]["time"],
+        threshold_sec, report_levels, transition_levels)
+    if out is not None:
+        match["mode"] = "auto"  # same side effect as the writers below
+    return out
+
+
+def report_wire(match, trace: dict, threshold_sec: float,
+                report_levels: Iterable[int],
+                transition_levels: Iterable[int]):
+    """The ``/report`` response body as BYTES — the serving path's
+    entry point (service/server.py hands the returned buffer to the
+    socket with no re-encode). A thin dispatcher over the wire backend
+    knob: the native C writer emits the whole body into one contiguous
+    buffer (memoryview, zero-copy); otherwise the Python writer's
+    string is encoded. All paths are byte-identical (pinned by
+    tests/test_report_writer.py)."""
+    mm = _matcher()
+    if isinstance(match, mm.MatchRuns):
+        out = _try_native_wire(match, trace, threshold_sec,
+                               report_levels, transition_levels)
+        if out is not None:
+            return out
+        from ..utils import metrics
+        metrics.count("wire.fallback")
+        # straight to the Python writer: report_json would re-attempt
+        # the native path this call just watched fail
+        return _report_json_py(match, trace, threshold_sec, report_levels,
+                               transition_levels).encode("utf-8")
+    return report_json(match, trace, threshold_sec, report_levels,
+                       transition_levels).encode("utf-8")
+
+
 def report_json(match, trace: dict, threshold_sec: float,
                 report_levels: Iterable[int],
                 transition_levels: Iterable[int]) -> str:
     """The whole ``/report`` response serialised straight from run
-    columns to JSON — the columnar response writer. Byte-identical to
+    columns to JSON, as a string — a thin dispatcher over the wire
+    backend knob (``REPORTER_TPU_WIRE_NATIVE``): native C writer when
+    armed, else the Python columnar writer. Byte-identical to
     ``json.dumps(report(...), separators=(",", ":"))`` (pinned by
     tests/test_report_writer.py); a plain-dict match (numpy fallback or
     hand-built) takes exactly that dict route."""
@@ -254,6 +312,20 @@ def report_json(match, trace: dict, threshold_sec: float,
         return json.dumps(
             report(match, trace, threshold_sec, report_levels,
                    transition_levels), separators=(",", ":"))
+    out = _try_native_wire(match, trace, threshold_sec, report_levels,
+                           transition_levels)
+    if out is not None:
+        return bytes(out).decode("utf-8")
+    return _report_json_py(match, trace, threshold_sec, report_levels,
+                           transition_levels)
+
+
+def _report_json_py(match, trace: dict, threshold_sec: float,
+                    report_levels: Iterable[int],
+                    transition_levels: Iterable[int]) -> str:
+    """The Python columnar writer — the wire dispatcher's fallback
+    backend and the oracle the native writer is pinned against."""
+    mm = _matcher()
     scan = _scan_segments(
         *_segment_columns(match), trace["trace"][-1]["time"],
         threshold_sec, set(report_levels), set(transition_levels))
@@ -281,10 +353,12 @@ def report_json(match, trace: dict, threshold_sec: float,
     if scan.shape_used:
         body += f',"shape_used":{scan.shape_used}'
     # the holdback cut is over REPORTED segments only; the echoed
-    # segment_matcher carries every run, like the dict path
+    # segment_matcher carries every run, like the dict path. The _py
+    # writer explicitly (not the dispatcher): this path IS the Python
+    # backend, and must stay pure-Python end to end
     body += (',"segment_matcher":'
-             + mm.render_segments_json(match.cols, match.lo, match.hi,
-                                       "auto")
+             + mm.render_segments_json_py(match.cols, match.lo, match.hi,
+                                          "auto")
              + ',"datastore":{"mode":"auto","reports":['
              + ",".join(parts) + "]}}")
     return body

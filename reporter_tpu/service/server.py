@@ -34,7 +34,7 @@ from ..matcher import Configure, SegmentMatcher
 from ..obs import trace as obs_trace
 from ..utils import metrics
 from .dispatch import BatchDispatcher
-from .report import report, report_json
+from .report import report, report_wire
 
 # /report is the reference's only action (reporter_service.py:26);
 # /stats is new — a metrics snapshot (counters + stage-timer
@@ -72,10 +72,18 @@ class ReporterService:
             max_wait_ms=max_wait_ms if max_wait_ms is not None else
             _env_float("MATCH_BATCH_WAIT_MS", 20.0),
             idle_grace_ms=_env_float("MATCH_BATCH_GRACE_MS", 2.0))
+        # pre-fork identity ("p<index>:<pid>", set by service/prefork.py
+        # worker_main): stamped on responses as X-Reporter-Proc so load
+        # tests and the chaos harness can see which worker answered;
+        # None (single-process mode) adds no header
+        self.proc_tag: str | None = None
 
-    def handle(self, trace: dict) -> tuple[int, str]:
-        """Validate + match + report; (status, body). Validation messages
-        mirror the reference (reporter_service.py:209-245)."""
+    def handle(self, trace: dict) -> "tuple[int, str | bytes | memoryview]":
+        """Validate + match + report; (status, body). The 200 body is
+        BYTES (a memoryview of the chunk buffer on the native wire
+        path) — _respond writes it to the socket as is; error bodies
+        stay str. Validation messages mirror the reference
+        (reporter_service.py:209-245)."""
         if trace.get("uuid") is None:
             return 400, '{"error":"uuid is required"}'
         try:
@@ -99,11 +107,13 @@ class ReporterService:
             match = self.dispatcher.submit(
                 trace, columns=(trace.get("uuid"), lat, lon, tm, acc,
                                 trace.get("match_options")))
-            # columnar response writer: serialise the whole response
-            # straight from the match's run columns — the per-trace
-            # report/segment dicts never exist on this path
+            # wire writer: the whole response body as bytes, straight
+            # from the match's run columns — ONE GIL-released C call on
+            # the native backend (memoryview handed to the socket with
+            # no re-encode), the Python columnar writer otherwise; the
+            # per-trace report/segment dicts never exist on this path
             with obs_trace.span("report.serialise"):
-                return 200, report_json(match, trace, self.threshold_sec,
+                return 200, report_wire(match, trace, self.threshold_sec,
                                         report_levels, transition_levels)
         except Exception as e:
             return 500, json.dumps({"error": str(e)})
@@ -247,14 +257,19 @@ def make_handler(service: ReporterService):
                 return json.loads(params["json"][0])
             raise ValueError("No json provided")
 
-        def _respond(self, code: int, body: str,
+        def _respond(self, code: int, body,
                      content_type: str = "application/json;charset=utf-8"):
-            raw = body.encode("utf-8")
+            # str bodies encode here; bytes/memoryview bodies (the
+            # native wire writer's buffer) go to the socket AS IS —
+            # the zero-copy handoff the C writer exists for
+            raw = body.encode("utf-8") if isinstance(body, str) else body
             # one request per connection, like the reference's HTTP/1.0
             # service — keep-alive would pin a bounded pool slot idle
             self.close_connection = True
             self.send_response(code)
             self.send_header("Access-Control-Allow-Origin", "*")
+            if service.proc_tag is not None:
+                self.send_header("X-Reporter-Proc", service.proc_tag)
             self.send_header("Content-type", content_type)
             self.send_header("Content-length", str(len(raw)))
             self.end_headers()
@@ -342,6 +357,8 @@ def make_handler(service: ReporterService):
                     with metrics.timer("service.handle"):
                         code, body = service.handle(trace)
                 if want_trace and code == 200:
+                    if not isinstance(body, str):  # native wire bytes
+                        body = bytes(body).decode("utf-8")
                     body = ('{"report":' + body + ',"trace":'
                             + json.dumps(obs_trace.export_trace(root),
                                          separators=(",", ":")) + "}")
@@ -404,21 +421,60 @@ class BoundedThreadingHTTPServer(ThreadingHTTPServer):
             self._slots.release()
 
 
+def make_server(service: ReporterService, host: str, port: int,
+                pool_size: int | None = None,
+                reuse_port: bool = False) -> BoundedThreadingHTTPServer:
+    """The ONE server constructor every entry point goes through, so
+    the THREAD_POOL_COUNT/_MULTIPLIER knobs apply uniformly (the old
+    ``__main__`` path constructed the server directly and silently
+    ignored them). ``reuse_port`` binds with SO_REUSEPORT — the
+    pre-fork multi-process mode's shared-port primitive."""
+    cls = ReusePortThreadingHTTPServer if reuse_port \
+        else BoundedThreadingHTTPServer
+    return cls((host, port), make_handler(service), pool_size)
+
+
+class ReusePortThreadingHTTPServer(BoundedThreadingHTTPServer):
+    """BoundedThreadingHTTPServer binding with ``SO_REUSEPORT``: N
+    processes each bind the same (host, port) and the kernel spreads
+    accepted connections across them — the pre-fork serving mode's
+    listener (service/prefork.py). Manual setsockopt: socketserver only
+    grew ``allow_reuse_port`` in Python 3.11."""
+
+    def server_bind(self):
+        import socket
+        self.socket.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+        super().server_bind()
+
+
 def serve(service: ReporterService, host: str, port: int,
           pool_size: int | None = None) -> BoundedThreadingHTTPServer:
-    httpd = BoundedThreadingHTTPServer((host, port), make_handler(service),
-                                       pool_size)
+    httpd = make_server(service, host, port, pool_size)
     thread = threading.Thread(target=httpd.serve_forever, daemon=True)
     thread.start()
     return httpd
 
 
 def main(argv=None):
-    argv = argv if argv is not None else sys.argv[1:]
+    argv = list(argv if argv is not None else sys.argv[1:])
+    # --procs N: pre-fork multi-process serving (SO_REUSEPORT); the
+    # REPORTER_TPU_SERVICE_PROCS env knob is the no-CLI spelling
+    procs = None
+    if "--procs" in argv:
+        i = argv.index("--procs")
+        try:
+            procs = int(argv[i + 1])
+        except (IndexError, ValueError):
+            sys.stderr.write("--procs needs an integer\n")
+            return 1
+        del argv[i:i + 2]
+    if procs is None:
+        from ..utils.runtime import _env_int
+        procs = _env_int("REPORTER_TPU_SERVICE_PROCS", 1)
     if len(argv) < 2:
         sys.stderr.write(
             "usage: python -m reporter_tpu.service.server <config.json> "
-            "<host:port>\n")
+            "<host:port> [--procs N]\n")
         return 1
     try:
         with open(argv[0]) as f:
@@ -430,27 +486,37 @@ def main(argv=None):
         sys.stderr.write(f"Problem with config file: {e}\n")
         return 1
 
-    # a "datastore" key in the config (or REPORTER_TPU_DATASTORE) mounts
-    # a local histogram store under /histogram
-    datastore = None
-    ds_root = os.environ.get("REPORTER_TPU_DATASTORE") \
-        or conf.get("datastore")
-    if ds_root:
-        from ..datastore import LocalDatastore
-        datastore = LocalDatastore(ds_root)
+    def make_service() -> ReporterService:
+        """Everything heavyweight — backend init, graph load, native
+        build, datastore mount — happens HERE, which in multi-process
+        mode runs post-fork in each worker: children never inherit
+        device handles, native worker pools or dispatcher threads."""
+        # a "datastore" key in the config (or REPORTER_TPU_DATASTORE)
+        # mounts a local histogram store under /histogram
+        datastore = None
+        ds_root = os.environ.get("REPORTER_TPU_DATASTORE") \
+            or conf.get("datastore")
+        if ds_root:
+            from ..datastore import LocalDatastore
+            datastore = LocalDatastore(ds_root)
 
-    # pin the JAX platform before any decode can block on a chip tunnel
-    # (REPORTER_TPU_PLATFORM=cpu|accel|auto; auto probes then falls back)
-    from ..utils.runtime import ensure_backend
-    ensure_backend()
+        # pin the JAX platform before any decode can block on a chip
+        # tunnel (REPORTER_TPU_PLATFORM=cpu|accel|auto)
+        from ..utils.runtime import ensure_backend
+        ensure_backend()
 
-    # joins a multi-host JAX job when REPORTER_TPU_COORDINATOR etc. are
-    # set; single-host no-op otherwise
-    from ..parallel import init_multihost
-    init_multihost()
+        # joins a multi-host JAX job when REPORTER_TPU_COORDINATOR etc.
+        # are set; single-host no-op otherwise
+        from ..parallel import init_multihost
+        init_multihost()
+        return ReporterService(SegmentMatcher(), datastore=datastore)
 
-    service = ReporterService(SegmentMatcher(), datastore=datastore)
-    httpd = BoundedThreadingHTTPServer((host, port), make_handler(service))
+    if procs > 1:
+        from .prefork import serve_prefork
+        return serve_prefork(make_service, host, port, procs)
+
+    service = make_service()
+    httpd = make_server(service, host, port)
     try:
         httpd.serve_forever()
     except KeyboardInterrupt:

@@ -1,0 +1,211 @@
+"""The /report wire backend: C-level writer vs Python columnar writer.
+
+PR 4 moved /report serialisation from per-run dicts to a Python
+columnar writer; this module finishes the wire path (ISSUE 11): the
+response bytes for a whole run-column slice are emitted by ONE
+GIL-released C call (native/src/host_runtime.cpp ``rt_report_json``,
+ABI 12) into one contiguous buffer that goes to the socket with no
+re-encode. The Python writer stays behind the same interface as the
+fallback backend and the byte-parity oracle.
+
+Backend knob — ``REPORTER_TPU_WIRE_NATIVE``:
+
+- unset / ``auto`` (default): native whenever the library loads
+- ``0`` / ``off`` / ``false`` / ``python``: always the Python writer
+
+Failure domain: the writer gets the PR 9 circuit-breaker treatment — a
+native writer fault (or an armed ``wire.native`` failpoint) counts a
+``wire.circuit`` failure and THAT response falls back to the Python
+writer byte-identically; enough consecutive failures open the circuit
+and later responses skip the native attempt until a half-open probe
+re-closes it. A writer fault therefore degrades, never 500s.
+
+Metrics: ``wire.native`` / ``wire.fallback`` responses and
+``wire.errors`` faults, plus the breaker's ``wire.circuit.*`` family.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import math
+import numbers
+import os
+from typing import Optional
+
+from .. import native
+from ..utils import faults, metrics
+from ..utils.circuit import CircuitBreaker
+from ..utils.runtime import _env_float, _env_int
+
+logger = logging.getLogger("reporter_tpu.wire")
+
+ENV_VAR = "REPORTER_TPU_WIRE_NATIVE"
+_OFF_VALUES = ("0", "off", "false", "python")
+
+#: the writer's failure domain (same threshold/cooldown knobs as the
+#: matcher's breakers): open = every response takes the Python writer
+circuit = CircuitBreaker(
+    "wire.circuit",
+    threshold=_env_int("REPORTER_TPU_CIRCUIT_THRESHOLD", 5),
+    cooldown_s=_env_float("REPORTER_TPU_CIRCUIT_COOLDOWN_S", 30.0))
+
+# knob-parse memo keyed on the raw env value: this runs once per
+# /report response, and the parse (and even ``os.environ.get`` itself,
+# ~1.4 us through os._Environ's key encoding) was measurable next to a
+# writer whose whole job is a handful of microseconds. The raw value
+# is read from os.environ's backing dict when the implementation
+# exposes it (CPython; ~0.1 us) — setenv/monkeypatch write through
+# that same dict, so tests stay free to flip the knob mid-process.
+try:
+    _env_data = os.environ._data  # type: ignore[attr-defined]
+    _ENV_KEY_RAW = os.environ.encodekey(ENV_VAR)  # type: ignore
+except AttributeError:  # pragma: no cover - non-CPython fallback
+    _env_data, _ENV_KEY_RAW = None, ENV_VAR
+_knob_memo = (b"\0unset", True)
+
+
+def use_native() -> bool:
+    """Resolve the backend knob: opted out, or native library absent,
+    means the Python writer; otherwise (default auto) the C writer."""
+    global _knob_memo
+    raw = _env_data.get(_ENV_KEY_RAW) if _env_data is not None \
+        else os.environ.get(ENV_VAR)
+    memo = _knob_memo
+    if raw != memo[0]:
+        val = raw.decode() if isinstance(raw, bytes) else raw
+        on = val is None or val.strip().lower() not in _OFF_VALUES
+        memo = _knob_memo = (raw, on)
+    if not memo[1]:
+        return False
+    return native.available()
+
+
+def level_mask(levels) -> Optional[int]:
+    """Levels as a 0..7 bitmask, or None when a mask cannot reproduce
+    the Python scan's SET-MEMBERSHIP semantics (the caller then takes
+    the Python writer — the semantic oracle). The scan tests
+    ``level in levels`` where level is an int in -1..7 (-1 = no
+    segment id), so:
+
+    - integral numbers in 0..7 become mask bits (bools and x.0 floats
+      compare equal to int levels in a set, so they coerce safely);
+    - non-integral / non-numeric values (2.5, "0", None) can never
+      equal an int level — they are DROPPED, never coerced (int("0")
+      would invent a match the Python writer does not make);
+    - a value equal to -1 CAN match the no-id level in the set test,
+      which no 0..7 mask expresses — that forces the fallback;
+    - integral values past 7 can never match (level = sid & 7): drop.
+    """
+    m = 0
+    for v in levels:
+        if isinstance(v, numbers.Integral):  # bool, int, numpy ints
+            iv = int(v)
+        elif isinstance(v, numbers.Real):  # float, numpy floats
+            f = float(v)
+            # inf/nan/2.5 can never equal an int level (and int(inf)
+            # raises — this runs BEFORE the degrade-never-500 try)
+            if not math.isfinite(f) or f != int(f):
+                continue
+            iv = int(f)
+        elif v is None or isinstance(v, (str, bytes)):
+            continue  # can never compare equal to an int level
+        else:
+            # an exotic numeric (Decimal, a user type with __eq__)
+            # MIGHT match in the set test — only the oracle knows
+            return None
+        if iv == -1:
+            return None
+        if 0 <= iv <= 7:
+            m |= 1 << iv
+    return m
+
+
+def maybe_native_report(arrays: dict, lo: int, hi: int, trace_end,
+                        threshold_sec, report_levels,
+                        transition_levels) -> Optional[memoryview]:
+    """The whole /report body from the C writer, or None when the
+    backend is off, the circuit is open, or the writer faulted (the
+    caller then takes the Python writer — byte-identical, pinned).
+
+    Chunk memo: when the batched assembler attached the chunk layout
+    (``_run_off``/``_trace_end``), the FIRST response serialised from
+    this chunk emits EVERY trace's body in one GIL-released C call
+    into one contiguous buffer, and later responses — including the
+    other requests micro-batched into the same decode — are zero-copy
+    memoryview slices of it. The memo is keyed on (threshold, masks)
+    and each slice is guarded by its trace's recorded end time, so a
+    caller with different options or a doctored trace falls back to
+    the exact per-trace C call instead of serving stale bytes. The
+    plain-dict memo write is GIL-atomic; two racing builders produce
+    byte-identical buffers and the last one wins (benign)."""
+    if not use_native() or not circuit.allow():
+        return None
+    rep_m = level_mask(report_levels)
+    trans_m = level_mask(transition_levels)
+    if rep_m is None or trans_m is None:
+        return None  # mask can't mirror the set semantics: Python path
+    threshold_sec = float(threshold_sec)
+    trace_end = float(trace_end)
+    key = (threshold_sec, rep_m, trans_m)
+    memo = arrays.get("_wire_chunk")
+    if memo is not None and memo[0] == key:
+        hit = memo[1].get((lo, hi))
+        if hit is not None and (hit[0] == trace_end or lo == hi):
+            metrics.count("wire.native")
+            return hit[1]
+    try:
+        faults.failpoint("wire.native")
+        out = None
+        # build the whole-chunk buffer only when this chunk has NO memo
+        # yet: with requests alternating two option sets in one chunk, a
+        # rebuild per mismatch would re-serialise the chunk per REQUEST
+        # (O(N^2) trace bodies) — mismatches take the per-trace call
+        if memo is None and "_run_off" in arrays:
+            buf, offsets = native.write_report_json_batch(
+                arrays, threshold_sec, rep_m, trans_m)
+            ro = arrays["_run_off"].tolist()
+            ends = arrays["_trace_end"].tolist()
+            mv = buf.data
+            slices = {}
+            for t in range(len(offsets) - 1):
+                slices[(ro[t], ro[t + 1])] = (
+                    ends[t], mv[offsets[t]:offsets[t + 1]])
+            arrays["_wire_chunk"] = (key, slices)
+            hit = slices.get((lo, hi))
+            if hit is not None and (hit[0] == trace_end or lo == hi):
+                out = hit[1]
+        if out is None:
+            out = native.write_report_json(
+                arrays, lo, hi, trace_end, threshold_sec, rep_m,
+                trans_m)
+    except Exception as e:
+        circuit.record_failure()
+        metrics.count("wire.errors")
+        logger.warning("native /report writer failed (%s); serving via "
+                       "the Python writer", e)
+        return None
+    circuit.record_success()
+    metrics.count("wire.native")
+    return out
+
+
+def maybe_native_segments(arrays: dict, lo: int, hi: int,
+                          mode: str) -> Optional[memoryview]:
+    """``{"segments":...,"mode":...}`` from the C writer, or None (same
+    degradation contract as :func:`maybe_native_report`)."""
+    if not use_native() or not circuit.allow():
+        return None
+    try:
+        faults.failpoint("wire.native")
+        mode_json = b'"auto"' if mode == "auto" \
+            else json.dumps(mode).encode("utf-8")
+        out = native.write_segments_json(arrays, lo, hi, mode_json)
+    except Exception as e:
+        circuit.record_failure()
+        metrics.count("wire.errors")
+        logger.warning("native segments writer failed (%s); serving via "
+                       "the Python writer", e)
+        return None
+    circuit.record_success()
+    metrics.count("wire.native")
+    return out

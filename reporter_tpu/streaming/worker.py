@@ -67,7 +67,10 @@ def inproc_submitter(service) -> Callable[[dict], Optional[dict]]:
         if code != 200:
             logger.error("in-process match failed (%d): %s", code, body)
             return None
-        return json.loads(body)
+        # the native wire path hands back a memoryview of the chunk
+        # buffer (zero-copy for sockets); json.loads wants bytes/str
+        return json.loads(bytes(body) if isinstance(body, memoryview)
+                          else body)
     return submit
 
 

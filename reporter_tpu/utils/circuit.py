@@ -48,6 +48,17 @@ class CircuitBreaker:
         self._mut = _locks.Guarded(
             {"state": CLOSED, "failures": 0, "opened_at": 0.0,
              "probe_inflight": False}, self._lock, f"circuit.{name}")
+        # lock-free steady-state flag, maintained UNDER the lock at
+        # every state/failure change: True only while CLOSED with zero
+        # recorded failures. allow()/record_success() read it without
+        # the lock on breakers sitting on per-response hot paths (the
+        # wire writer's sits next to a ~3 us C call; the lock round
+        # trips cost more than the protected work). The benign race —
+        # a reader seeing a just-stale True — admits one extra attempt
+        # or skips one failure-counter reset; a breaker's consecutive-
+        # failure threshold is a heuristic either way, and all state
+        # WRITES stay serialised under the lock (racecheck RC003).
+        self._fast_ok = True
 
     @property
     def state(self) -> str:
@@ -63,6 +74,8 @@ class CircuitBreaker:
     def allow(self) -> bool:
         """May the protected operation run right now? Open denies;
         half-open admits one probe at a time."""
+        if self._fast_ok:
+            return True
         with self._lock:
             st = self._mut
             if st["state"] == CLOSED:
@@ -79,6 +92,8 @@ class CircuitBreaker:
         return True
 
     def record_success(self) -> None:
+        if self._fast_ok:  # already CLOSED with nothing to reset
+            return
         closed_now = False
         with self._lock:
             st = self._mut
@@ -87,6 +102,7 @@ class CircuitBreaker:
             if st["state"] != CLOSED:
                 st["state"] = CLOSED
                 closed_now = True
+            self._fast_ok = True
         if closed_now:
             self._registry.count(f"{self.name}.closed")
 
@@ -96,6 +112,7 @@ class CircuitBreaker:
             st = self._mut
             st["probe_inflight"] = False
             st["failures"] += 1
+            self._fast_ok = False
             if st["state"] == HALF_OPEN or (
                     st["state"] == CLOSED
                     and st["failures"] >= self.threshold):

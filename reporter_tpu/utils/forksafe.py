@@ -1,0 +1,70 @@
+"""Post-fork re-initialisation of process-wide singletons.
+
+``os.fork()`` copies exactly one thread into the child. Every other
+thread — and everything it was doing — vanishes mid-instruction: a lock
+another thread held at fork time stays locked FOREVER in the child, a
+ring buffer may hold a half-written record, cached byte-estimates
+describe the parent's world. The pre-fork serving mode
+(service/prefork.py) forks before any of that state gets interesting,
+but fork safety must not depend on call ordering — so this module gives
+each singleton-owning module a registered child-side reset hook, run by
+``os.register_at_fork(after_in_child=...)`` in registration order.
+
+Registered today (each module registers its own hook at import):
+
+- ``utils.locks``   — every TrackedLock's inner ``threading.Lock`` is
+  replaced with a fresh one (a parent thread's hold cannot deadlock the
+  child); thread-affinity tags reset lazily via the changed thread ids
+- ``utils.metrics`` — the default registry's counters/timers clear: a
+  child's /metrics reports ITS work, not a copy-on-write snapshot of
+  the parent's (the per-process metrics contract, README "Serving")
+- ``utils.spool``   — cached byte-estimates and backlog TTL caches
+  clear (they described the parent's view of the spool roots)
+- ``obs.flightrec`` — the span ring and open-span table clear: a child
+  postmortem must carry the child's spans, not inherited ones
+- ``analysis.racecheck`` — held-stack and lock-order-graph state clears
+  (acquisitions recorded by parent threads never release in the child)
+
+Hooks must be idempotent, cheap and exception-free: they run on EVERY
+fork in the process (including subprocess's transient fork-exec
+children), and a raising hook would poison unrelated forks. Failures
+are logged and swallowed.
+"""
+from __future__ import annotations
+
+import logging
+import os
+from typing import Callable, List
+
+logger = logging.getLogger("reporter_tpu.forksafe")
+
+_hooks: List[Callable[[], None]] = []
+_registered = False
+
+
+def register(hook: Callable[[], None]) -> None:
+    """Add a child-side reset hook (run in registration order). The
+    process-wide ``register_at_fork`` handler installs lazily on the
+    first registration — importing this module alone changes nothing."""
+    global _registered
+    _hooks.append(hook)
+    if not _registered:
+        os.register_at_fork(after_in_child=_run_hooks)
+        _registered = True
+
+
+def _run_hooks() -> None:
+    for hook in _hooks:
+        try:
+            hook()
+        except Exception as e:  # never poison an unrelated fork
+            try:
+                logger.error("post-fork reset hook %r failed: %s",
+                             hook, e)
+            except Exception:
+                pass
+
+
+def hook_count() -> int:
+    """Registered hook count (test surface)."""
+    return len(_hooks)

@@ -38,8 +38,10 @@ from __future__ import annotations
 import logging
 import os
 import random
+import sys
 import threading
 import time
+import weakref
 import zlib
 from typing import Optional
 
@@ -59,6 +61,11 @@ _ENABLED = False      # the one flag every disarmed lock site loads
 _RAW = False          # new_lock() hands out bare threading.Lock
 _FUZZ: Optional["_FuzzSpec"] = None
 _witness = None       # reporter_tpu.analysis.racecheck, set by arm()
+#: every live TrackedLock, for the post-fork sweep (WeakSet: a lock's
+#: lifetime is its owner's — the sweep must not extend it). Mutated
+#: only from __init__ under the GIL; iterated single-threaded in the
+#: child's fork hook.
+_instances: "weakref.WeakSet[TrackedLock]" = weakref.WeakSet()
 
 
 class TrackedLock:
@@ -73,13 +80,14 @@ class TrackedLock:
     the design) from RC002.
     """
 
-    __slots__ = ("_lock", "name", "long_hold_ok", "_owner")
+    __slots__ = ("_lock", "name", "long_hold_ok", "_owner", "__weakref__")
 
     def __init__(self, name: str, long_hold_ok: bool = False):
         self._lock = threading.Lock()
         self.name = name
         self.long_hold_ok = long_hold_ok
         self._owner = 0  # acquiring thread id, maintained only when armed
+        _instances.add(self)  # fork-safety sweep (forksafe reset hook)
 
     def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
         if _FUZZ is not None:
@@ -352,6 +360,43 @@ def _env_float(name: str, default: float) -> float:
     except ValueError:
         logger.error("ignoring malformed %s=%r", name, raw)
         return default
+
+
+# ---- fork safety -----------------------------------------------------------
+
+def _fork_reset() -> None:
+    """Post-fork child-side sweep (utils.forksafe): a lock some OTHER
+    parent thread held at fork time is locked FOREVER in the child — no
+    thread exists to release it — so its inner ``threading.Lock`` is
+    replaced with a fresh one. The surviving thread's own holds are kept
+    when ownership is known (armed mode maintains ``_owner``); disarmed,
+    any locked lock is presumed orphaned — the pre-fork serving mode
+    forks from a quiet parent, and a fork taken INSIDE a package lock
+    would be the bug this sweep exists to surface. Runs FIRST among the
+    forksafe hooks (this module registers at import, before every
+    consumer of new_lock), so later hooks can safely take the locks
+    guarding the state they reset."""
+    me = threading.get_ident()
+    for lk in list(_instances):
+        try:
+            if lk._lock.locked() and lk._owner != me:
+                lk._lock = threading.Lock()
+                lk._owner = 0
+        except Exception:  # a dying referent mid-sweep must not poison it
+            pass
+    # the armed witness's held-before graph records parent acquisitions
+    # that will never release in the child
+    rc = sys.modules.get("reporter_tpu.analysis.racecheck")
+    if rc is not None:
+        try:
+            rc.fork_reset()
+        except Exception:
+            pass
+
+
+from . import forksafe as _forksafe  # noqa: E402  (import registers nothing)
+
+_forksafe.register(_fork_reset)
 
 
 # arm from the environment at import: the racecheck CI stage and the

@@ -219,6 +219,13 @@ timer = default.timer
 observe = default.observe
 snapshot = default.snapshot
 
+# fork safety: a forked worker's /metrics must report ITS work, not a
+# copy-on-write snapshot of the parent's (per-process metrics contract,
+# README "Serving") — the child's default registry starts empty
+from . import forksafe as _forksafe  # noqa: E402
+
+_forksafe.register(default.reset)
+
 
 @contextlib.contextmanager
 def device_trace(out_dir: str) -> Iterator[None]:

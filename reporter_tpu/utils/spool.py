@@ -207,6 +207,20 @@ def write(root: str, relpath: str, payload: str,
     return path
 
 
+# fork safety: the byte estimates and backlog gauges described the
+# PARENT's view of the spool roots; a child seeds fresh ones from disk
+# on first use. The roots themselves (tile/trace dirs) stay — they are
+# configuration, and forked workers share the deployment's spools.
+def _fork_reset() -> None:
+    with _lock:
+        _approx_bytes.clear()
+        _backlog_cache.clear()
+
+
+from . import forksafe as _forksafe  # noqa: E402
+
+_forksafe.register(_fork_reset)
+
 __all__ = ["write", "enforce_cap", "backlog", "backlog_cached",
            "backlog_snapshot", "cap_bytes", "walk_files", "set_tile_dir",
            "set_trace_dir", "tile_dir", "trace_dir", "NESTED_SPOOLS"]

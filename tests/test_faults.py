@@ -855,3 +855,83 @@ class TestHealthDegradedBlock:
         assert code == 503
         assert body["degraded"]["open"] == ["decode.dispatch"]
         assert body["status"] == "degraded"
+
+
+class TestWireDomain:
+    """The native wire writer's failure domain (ISSUE 11): an armed
+    ``wire.native`` failpoint degrades that response to the Python
+    columnar writer BYTE-IDENTICALLY — never a 500 — while counting
+    ``wire.errors``/``wire.fallback`` and feeding the ``wire.circuit``
+    breaker."""
+
+    def test_wire_native_fault_degrades_byte_identically(self):
+        from reporter_tpu import native
+        if not native.available():
+            pytest.skip("native toolchain unavailable")
+        from reporter_tpu.matcher import SegmentMatcher
+        from reporter_tpu.matcher.matcher import MatchRuns
+        from reporter_tpu.service import wire
+        from reporter_tpu.service.report import (_report_json_py,
+                                                 report_wire)
+        city = _grid_city()
+        m = SegmentMatcher(net=city)
+        req = _reqs(city, n=1)[0]
+        match = m.match_many([req])[0]
+        assert isinstance(match, MatchRuns)
+        want = _report_json_py(match, req, 15, {0, 1, 2},
+                               {0, 1, 2}).encode("utf-8")
+        # healthy path: the C writer answers, byte-identical
+        n0 = metrics.counter("wire.native")
+        assert bytes(report_wire(match, req, 15, {0, 1, 2},
+                                 {0, 1, 2})) == want
+        assert metrics.counter("wire.native") == n0 + 1
+        # armed fault: same bytes via the Python writer, error counted.
+        # A FRESH match — the previous call memoised its chunk's native
+        # bytes, and a memo hit never re-enters the writer (or its
+        # failpoint): there is no writer work left to fail there.
+        match = m.match_many([req])[0]
+        faults.configure("wire.native=error")
+        e0 = metrics.counter("wire.errors")
+        f0 = metrics.counter("wire.fallback")
+        out = report_wire(match, req, 15, {0, 1, 2}, {0, 1, 2})
+        assert bytes(out) == want
+        assert metrics.counter("wire.errors") == e0 + 1
+        assert metrics.counter("wire.fallback") == f0 + 1
+        # disarm and close the breaker again (module singleton)
+        faults.clear()
+        assert bytes(report_wire(match, req, 15, {0, 1, 2},
+                                 {0, 1, 2})) == want
+        assert wire.circuit.state == "closed"
+
+    def test_wire_circuit_opens_and_skips_native(self):
+        from reporter_tpu import native
+        if not native.available():
+            pytest.skip("native toolchain unavailable")
+        from reporter_tpu.matcher import SegmentMatcher
+        from reporter_tpu.matcher.matcher import MatchRuns
+        from reporter_tpu.service import wire
+        from reporter_tpu.service.report import (_report_json_py,
+                                                 report_wire)
+        city = _grid_city()
+        m = SegmentMatcher(net=city)
+        req = _reqs(city, n=1)[0]
+        match = m.match_many([req])[0]
+        assert isinstance(match, MatchRuns)
+        want = _report_json_py(match, req, 15, {0, 1, 2},
+                               {0, 1, 2}).encode("utf-8")
+        faults.configure("wire.native=error")
+        try:
+            for _ in range(wire.circuit.threshold):
+                assert bytes(report_wire(match, req, 15, {0, 1, 2},
+                                         {0, 1, 2})) == want
+            assert wire.circuit.state == "open"
+            # open circuit: the native attempt (and its failpoint) is
+            # skipped outright — errors stop accruing, service continues
+            e_open = metrics.counter("wire.errors")
+            assert bytes(report_wire(match, req, 15, {0, 1, 2},
+                                     {0, 1, 2})) == want
+            assert metrics.counter("wire.errors") == e_open
+        finally:
+            faults.clear()
+            wire.circuit.record_success()  # re-close the singleton
+        assert wire.circuit.state == "closed"

@@ -1,0 +1,186 @@
+"""Fork-safety of module singletons (utils.forksafe reset hooks).
+
+``os.fork()`` copies one thread; everything the parent's OTHER threads
+were doing is frozen into the child forever — a held lock never
+releases, the metrics registry snapshots the parent's work, the flight
+recorder ring carries inherited spans. The pre-fork serving mode
+(service/prefork.py) leans on the ``utils.forksafe`` hooks to reset all
+of it in the child; these tests pin each hook by actually forking.
+
+Every fork here happens from THIS pytest process but touches only
+numpy/stdlib state (no jax in the children), and children always exit
+via ``os._exit`` so a failing assertion cannot unwind into a second
+copy of the pytest session.
+"""
+import os
+import signal
+import threading
+import time
+
+import pytest
+
+from reporter_tpu.utils import forksafe, locks, metrics, spool
+from reporter_tpu.obs import flightrec
+
+
+def _fork_and_check(child_fn) -> int:
+    """Run ``child_fn`` in a forked child; return its exit code. The
+    child exits 0 when child_fn returns truthy, 1 otherwise, 2 on an
+    exception — and never returns into pytest."""
+    pid = os.fork()
+    if pid == 0:
+        code = 2
+        try:
+            code = 0 if child_fn() else 1
+        except BaseException:
+            pass
+        finally:
+            os._exit(code)
+    deadline = time.monotonic() + 30.0
+    while time.monotonic() < deadline:
+        done, status = os.waitpid(pid, os.WNOHANG)
+        if done == pid:
+            return os.waitstatus_to_exitcode(status)
+        time.sleep(0.02)
+    os.kill(pid, signal.SIGKILL)
+    os.waitpid(pid, 0)
+    pytest.fail("forked child hung (orphaned lock not reset?)")
+
+
+def test_hooks_registered_at_import():
+    # locks, metrics, spool, flightrec each register exactly one hook
+    # at import (racecheck resets via the locks hook — it stays
+    # stdlib-only and cannot import forksafe itself)
+    assert forksafe.hook_count() >= 4
+
+
+def test_orphaned_tracked_lock_resets_in_child():
+    """A TrackedLock held by a thread that does not survive the fork
+    must be usable in the child — the hook swaps in a fresh inner lock
+    instead of leaving one locked forever."""
+    lk = locks.new_lock("forksafe.test.orphan")
+    t = threading.Thread(target=lk.acquire)
+    t.start()
+    t.join()
+    assert lk.locked()
+
+    def child():
+        if lk.locked():
+            return False
+        with lk:  # must not deadlock
+            pass
+        return True
+
+    assert _fork_and_check(child) == 0
+    # the parent's view is untouched: its (vanished-thread) hold remains
+    assert lk.locked()
+    lk._lock = threading.Lock()  # don't leak a held lock into the sweep
+
+
+def test_metrics_registry_resets_in_child():
+    """A forked worker's /metrics reports ITS work, not a copy-on-write
+    snapshot of the parent's (per-process metrics contract)."""
+    metrics.count("forksafe.test.sentinel", 7)
+
+    def child():
+        if metrics.counter("forksafe.test.sentinel") != 0:
+            return False
+        metrics.count("forksafe.test.child")
+        return metrics.counter("forksafe.test.child") == 1
+
+    assert _fork_and_check(child) == 0
+    # parent registry untouched by the child's reset
+    assert metrics.counter("forksafe.test.sentinel") == 7
+
+
+def test_spool_caches_reset_in_child(tmp_path):
+    """Byte estimates and backlog gauges describe the PARENT's view of
+    the spool roots; the child re-seeds from disk on first use."""
+    root = str(tmp_path / "spool")
+    spool.write(root, "a/tile.json", "x" * 64)
+    with spool._lock:
+        spool._approx_bytes[root] = 12345  # simulate a stale estimate
+    spool.backlog_cached(root)  # populate the TTL cache
+
+    def child():
+        with spool._lock:
+            if spool._approx_bytes.unwrap() or \
+                    spool._backlog_cache.unwrap():
+                return False
+        # fresh walk still sees the shared on-disk spool
+        return spool.backlog(root)["files"] == 1
+
+    assert _fork_and_check(child) == 0
+    with spool._lock:
+        assert spool._approx_bytes[root] == 12345
+
+
+def test_flightrec_ring_resets_in_child():
+    """A child postmortem carries the child's spans, not inherited
+    ones; the dump-dir configuration (deployment-shared) survives."""
+    flightrec.record_closed([{"name": "parent.span", "t0_ns": 1,
+                              "dur_ns": 2}])
+    assert flightrec.events()
+
+    def child():
+        return not flightrec.events() and not flightrec.in_flight()
+
+    assert _fork_and_check(child) == 0
+    assert flightrec.events()  # parent ring untouched
+
+
+def test_racecheck_state_resets_in_child():
+    """Armed-witness graph state records parent acquisitions that will
+    never release in the child — the locks hook clears it."""
+    from reporter_tpu.analysis import racecheck
+    was_armed = locks.armed()
+    locks.arm()
+    try:
+        a = locks.new_lock("forksafe.test.rc.a")
+        b = locks.new_lock("forksafe.test.rc.b")
+        with a:
+            with b:
+                pass
+        assert racecheck.edge_count() >= 1
+
+        def child():
+            return racecheck.edge_count() == 0
+
+        assert _fork_and_check(child) == 0
+        assert racecheck.edge_count() >= 1
+    finally:
+        if not was_armed:
+            locks.disarm()
+        racecheck.reset()
+
+
+def test_native_runtime_fork_guard():
+    """The native handle's C++ worker-pool threads do not survive a
+    fork: a child calling through an inherited handle must get a loud
+    RuntimeError (the matcher's circuit breaker degrades around it),
+    not a condvar hang. The route memo rides the handle, so this guard
+    is also its proven-unsafe-but-guarded fork story."""
+    from reporter_tpu import native
+    if not native.available():
+        pytest.skip("native toolchain unavailable")
+    from reporter_tpu.synth import build_grid_city
+    city = build_grid_city(rows=4, cols=4, spacing_m=200.0, seed=5,
+                           service_road_fraction=0.0,
+                           internal_fraction=0.0)
+    rt = native.NativeRuntime(city)
+    # sanity in the parent
+    assert rt.candidates([city.node_lat[0]], [city.node_lon[0]], 4) \
+        is not None
+
+    def child():
+        try:
+            rt.candidates([city.node_lat[0]], [city.node_lon[0]], 4)
+        except RuntimeError as e:
+            return "fork" in str(e)
+        return False
+
+    assert _fork_and_check(child) == 0
+    # the parent's handle still works afterwards (the child neither
+    # used nor destroyed it)
+    assert rt.candidates([city.node_lat[0]], [city.node_lon[0]], 4) \
+        is not None

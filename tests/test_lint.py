@@ -409,11 +409,15 @@ def test_abi_bad_fixture_catches_every_drift_class():
     assert rules == {"ABI001", "ABI002", "ABI003", "ABI004", "ABI005"}
 
 
-def test_abi_live_pair_validates_at_version_11():
+def test_abi_live_pair_validates_at_version_12():
     cpp = _read(LIVE_CPP)
     exports, version = abi.parse_cpp(cpp)
-    assert version == 11
+    assert version == 12
     assert "rt_prepare_batch" in exports and "rt_assemble_batch" in exports
+    # the ABI-12 wire writers are part of the checked surface
+    assert "rt_report_json" in exports \
+        and "rt_report_json_batch" in exports \
+        and "rt_render_segments_json" in exports
     findings = abi.check(cpp, _read(LIVE_PY))
     assert findings == [], [f.render() for f in findings]
 

@@ -146,3 +146,161 @@ def test_match_runs_mapping_protocol(fixture, matchers):
     # serialisation goes through the writers
     with pytest.raises(TypeError):
         json.dumps(m_native.match_many([req])[0])
+
+
+# ---- ISSUE 11: the native wire writer (ABI 12) ----------------------------
+# Cross-path property: for every fixture trace and level combination,
+# native C writer bytes == Python columnar writer bytes == legacy dict
+# path bytes — including the whole-chunk batch emission's per-trace
+# slices, the repr-parity float formatter, and the backend knob.
+
+from reporter_tpu.service import wire
+from reporter_tpu.service.report import _report_json_py, report_wire
+
+
+def test_wire_cross_path_property(fixture, matchers):
+    """native bytes == Python writer bytes == legacy dict path, across
+    every fixture request and LEVELS combination, on the native-prep
+    path. Each (request, levels) cell exercises BOTH the whole-chunk
+    batch emission (fresh match -> memo build + slice) and the
+    per-trace C call (memo popped)."""
+    m_native, _ = matchers
+    if m_native is None:
+        pytest.skip("native toolchain unavailable")
+    if not wire.use_native():
+        pytest.skip("native wire backend unavailable")
+    reqs = fixture["requests"]
+    checked = 0
+    for threshold, rep, trans in LEVELS:
+        matches = m_native.match_many(reqs)
+        for req, match in zip(reqs, matches):
+            if not isinstance(match, MatchRuns):
+                continue
+            dict_bytes = _dict_path_bytes(match, req, threshold, rep,
+                                          trans)
+            py_bytes = _report_json_py(match, req, threshold, rep,
+                                       trans)
+            # chunk path: first call builds the whole-chunk buffer,
+            # this trace's body is a zero-copy slice of it
+            sliced = report_wire(match, req, threshold, rep, trans)
+            assert isinstance(sliced, memoryview)
+            # per-trace path: with the memo dropped, the same bytes
+            # come from the single-trace C call
+            match.cols.arrays.pop("_wire_chunk", None)
+            memo_off = dict(match.cols.arrays)
+            memo_off.pop("_run_off", None)
+            memo_off.pop("_trace_end", None)
+            from reporter_tpu import native
+            per_trace = native.write_report_json(
+                memo_off, match.lo, match.hi,
+                float(req["trace"][-1]["time"]), float(threshold),
+                wire.level_mask(rep), wire.level_mask(trans))
+            assert bytes(sliced) == py_bytes.encode("utf-8") \
+                == dict_bytes.encode("utf-8") == bytes(per_trace)
+            checked += 1
+    assert checked >= 4 * 8  # all level combos, most fixture traces
+
+
+def test_wire_knob_pins_python_writer(fixture, matchers, monkeypatch):
+    """REPORTER_TPU_WIRE_NATIVE=off pins the Python columnar writer —
+    same bytes, str (not memoryview), zero wire.native counts."""
+    from reporter_tpu.utils import metrics
+    m_native, _ = matchers
+    if m_native is None:
+        pytest.skip("native toolchain unavailable")
+    req = fixture["requests"][0]
+    match = m_native.match_many([req])[0]
+    want = _report_json_py(match, req, 15, {0, 1, 2}, {0, 1, 2})
+    monkeypatch.setenv(wire.ENV_VAR, "off")
+    n0 = metrics.counter("wire.native")
+    out = report_wire(match, req, 15, {0, 1, 2}, {0, 1, 2})
+    assert not wire.use_native()
+    assert isinstance(out, bytes) and out == want.encode("utf-8")
+    assert metrics.counter("wire.native") == n0
+    monkeypatch.delenv(wire.ENV_VAR)
+    assert wire.use_native()
+
+
+def test_json_double_matches_repr():
+    """The C float formatter is pinned against CPython repr()/_jnum
+    over the wire's value population: integer-valued doubles, 3-decimal
+    rounded epochs/kms, sentinels, and general shortest-repr values."""
+    from reporter_tpu import native
+    if not native.available():
+        pytest.skip("native toolchain unavailable")
+    import numpy as np
+    values = [0.0, -0.0, -1.0, 1.0, 3.125, 1234.567, 0.1, 0.5, 0.25,
+              0.062, 0.0625, 1e-7, 123456789.123, 1.5e9 + 0.123,
+              1.7976931348623157e308, 2.5, 97.001, 1e12 + 0.375,
+              float("inf"), float("-inf"), float("nan")]
+    rng = np.random.default_rng(3)
+    values += list(np.round(rng.uniform(0, 2e9, 500), 3))
+    values += list(rng.uniform(0, 1, 200))        # general repr path
+    values += [float(v) for v in rng.integers(0, 10**15, 100)]
+    for v in values:
+        got = native.json_double(float(v)).decode()
+        assert got == _jnum(float(v)), v
+
+
+def test_wire_batch_slices_cover_whole_chunk(fixture, matchers):
+    """The whole-chunk buffer partitions exactly: per-trace slices are
+    contiguous, non-overlapping and cover every emitted byte."""
+    from reporter_tpu import native
+    m_native, _ = matchers
+    if m_native is None:
+        pytest.skip("native toolchain unavailable")
+    reqs = fixture["requests"]
+    matches = m_native.match_many(reqs)
+    chunk = next(m for m in matches if isinstance(m, MatchRuns))
+    arrays = chunk.cols.arrays
+    assert "_run_off" in arrays and "_trace_end" in arrays
+    buf, offsets = native.write_report_json_batch(arrays, 15.0, 7, 7)
+    assert offsets[0] == 0 and offsets[-1] <= len(buf)
+    assert all(a <= b for a, b in zip(offsets, offsets[1:]))
+    # every slice is a parseable /report body
+    for t in range(len(offsets) - 1):
+        body = bytes(buf.data[offsets[t]:offsets[t + 1]])
+        parsed = json.loads(body)
+        assert set(parsed) >= {"stats", "segment_matcher", "datastore"}
+
+
+def test_wire_level_semantics_match_python_set_membership(fixture,
+                                                         matchers):
+    """The mask conversion must never invent or lose a match the
+    Python scan's SET-MEMBERSHIP test makes: non-canonical level
+    values (strings, non-integral floats, -1) either convert exactly
+    or force the Python writer — bytes stay identical either way."""
+    from reporter_tpu.utils import metrics
+    m_native, _ = matchers
+    if m_native is None:
+        pytest.skip("native toolchain unavailable")
+    req = fixture["requests"][0]
+    cases = [
+        # strings can never equal an int level: dropped, not coerced
+        ({"0", "1", "2"}, {0, 1, 2}),
+        ({0, 1, 2}, {"0", "2"}),
+        # non-integral floats can never match; integral floats compare
+        # equal to int levels and convert exactly
+        ({0.0, 1.0, 2.0}, {0, 1, 2}),
+        ({2.5, 0}, {0, 1, 2}),
+        # -1 matches the no-segment-id level in the set test — only
+        # the Python writer expresses that
+        ({0, 1, 2}, {-1, 0, 1, 2}),
+        ({-1.0}, {0, 1, 2}),
+        # unmatchable big levels drop consistently
+        ({0, 1, 2, 9, 250}, {0, 1, 2}),
+    ]
+    for rep, trans in cases:
+        match = m_native.match_many([req])[0]
+        want = _report_json_py(match, req, 15, rep, trans)
+        got = report_wire(match, req, 15, rep, trans)
+        assert bytes(got) == want.encode("utf-8"), (rep, trans)
+    # masks bail out exactly when membership is inexpressible
+    assert wire.level_mask({0, 1, 2}) == 0b111
+    assert wire.level_mask({0.0, 2.0}) == 0b101
+    assert wire.level_mask({"0", 1}) == 0b010
+    assert wire.level_mask({2.5, 1}) == 0b010
+    assert wire.level_mask({-1}) is None
+    assert wire.level_mask({-1.0, 0}) is None
+    assert wire.level_mask({True, False}) == 0b011
+    assert wire.level_mask({9, 250, -3}) == 0

@@ -22,9 +22,10 @@ import subprocess
 import sys
 
 REQUIRED_TOP = ("metric", "value", "unit", "vs_baseline", "stages",
-                "baseline", "probe")
+                "report_writers", "baseline", "probe")
 REQUIRED_STAGES = ("prep", "decode_dispatch", "decode_wait", "assemble",
-                   "report", "total", "prep_share", "pipelined")
+                   "report", "total", "prep_share", "report_share",
+                   "pipelined")
 # native prep phase split (candidates / select / routes) — present
 # whenever the C++ runtime ran the prep, which CI guarantees via the
 # build stage; a dropped phase counter fails here, not in a review
@@ -86,6 +87,24 @@ def main(argv=None) -> int:
         sys.stderr.write(
             f"bench smoke: stages.prep_share out of range: {share}\n")
         return 1
+    r_share = stages["report_share"]
+    if not (isinstance(r_share, float) and 0.0 <= r_share <= 1.0):
+        sys.stderr.write(
+            f"bench smoke: stages.report_share out of range: {r_share}\n")
+        return 1
+    # the wire-backend split (ISSUE 11): all three legs must time when
+    # the C writer is available (CI's build stage guarantees it is).
+    # Without the native toolchain there are no MatchRuns to serialise
+    # (the numpy fallback returns plain dicts) and the split is None —
+    # the smoke must keep passing on native-less boxes, like the
+    # native-stage checks above
+    writers = art.get("report_writers") or {}
+    if native_ok:
+        for k in ("python_s", "dict_s", "dict_vs_python", "native_s"):
+            if not isinstance(writers.get(k), (int, float)):
+                sys.stderr.write(
+                    f"bench smoke: report_writers.{k} missing\n")
+                return 1
     if not (art["value"] > 0 and art["vs_baseline"] > 0):
         sys.stderr.write("bench smoke: non-positive throughput\n")
         return 1
@@ -93,7 +112,9 @@ def main(argv=None) -> int:
         with open(args.out, "w", encoding="utf-8") as f:
             json.dump(art, f)
     print(f"bench smoke ok: {art['value']} traces/sec, "
-          f"prep_share={share}, pipelined={stages['pipelined']}"
+          f"prep_share={share}, report_share={r_share}, "
+          f"native_vs_python={writers.get('native_vs_python')}, "
+          f"pipelined={stages['pipelined']}"
           + (f", artifact -> {args.out}" if args.out else ""))
     return 0
 

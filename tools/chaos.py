@@ -776,9 +776,168 @@ def scenario_replay_drain() -> int:
         os.environ.pop("REPORTER_TPU_REPLAY_ATTEMPTS", None)
 
 
+# ---------------------------------------------------------------------------
+_PREFORK_SCRIPT = r"""
+import json, os, signal, socket, sys, threading, time, urllib.request
+
+import numpy as np
+
+from reporter_tpu.matcher import SegmentMatcher
+from reporter_tpu.service.prefork import serve_prefork
+from reporter_tpu.service.server import ReporterService
+from reporter_tpu.synth import build_grid_city, generate_trace
+
+city = build_grid_city(rows=8, cols=8, spacing_m=200.0, seed=3,
+                       service_road_fraction=0.0, internal_fraction=0.0)
+with socket.socket() as s:
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+base = f"http://127.0.0.1:{port}"
+
+
+def make_service():
+    return ReporterService(SegmentMatcher(net=city), threshold_sec=15,
+                           max_batch=64, max_wait_ms=5.0)
+
+
+def req_body(seed):
+    rng = np.random.default_rng(seed)
+    tr = None
+    while tr is None:
+        tr = generate_trace(city, f"veh-{seed}", rng, noise_m=3.0)
+    return json.dumps(tr.request_json()).encode()
+
+
+def call(path, body=None, timeout=120.0):
+    r = urllib.request.Request(base + path, data=body,
+                               method="POST" if body else "GET")
+    with urllib.request.urlopen(r, timeout=timeout) as resp:
+        return resp.status, resp.headers.get("X-Reporter-Proc"), resp.read()
+
+
+verdict = {"ok": False}
+
+
+def probe():
+    time.sleep(2.0)  # fork window: children must fork off a quiet parent
+    try:
+        _probe()
+    except Exception as e:
+        verdict["err"] = f"{type(e).__name__}: {e}"
+
+
+def _probe():
+    deadline = time.time() + 180
+    while True:
+        try:
+            call("/stats", timeout=5)
+            break
+        except Exception:
+            if time.time() > deadline:
+                verdict["err"] = "service never came up"
+                return
+            time.sleep(0.2)
+    bodies = [req_body(i) for i in range(6)]
+    tags = {}
+    for i in range(300):
+        st, tag, _ = call("/report", bodies[i % len(bodies)])
+        assert st == 200 and tag
+        tags.setdefault(tag.split(":")[0], tag)
+        if len(tags) == 2 and i >= 10:
+            break
+    if len(tags) < 2:
+        verdict["err"] = f"one worker answered everything: {tags}"
+        return
+    os.kill(int(tags["p0"].split(":")[1]), signal.SIGKILL)
+    retried = 0
+    for i in range(30):
+        try:
+            st, _t, _ = call("/report", bodies[i % len(bodies)])
+        except Exception:
+            retried += 1
+            st, _t, _ = call("/report", bodies[i % len(bodies)])
+        assert st == 200
+        time.sleep(0.02)
+    new_tag = None
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        _st, tag, _ = call("/stats", timeout=10)
+        if tag and tag.startswith("p0:") and tag != tags["p0"]:
+            new_tag = tag
+            break
+        time.sleep(0.1)
+    verdict.update(ok=bool(new_tag), retried=retried,
+                   tags=sorted(tags.values()), new_tag=new_tag)
+
+
+t = threading.Thread(target=probe, daemon=True)
+try:
+    urllib.request.urlopen(base + "/stats", timeout=0.2)
+except Exception:
+    pass  # warms the opener machinery in the MAIN thread, pre-fork
+t.start()
+
+
+def reaper():
+    t.join()
+    os.kill(os.getpid(), signal.SIGTERM)
+
+
+threading.Thread(target=reaper, daemon=True).start()
+rc = serve_prefork(make_service, "127.0.0.1", port, 2)
+print("VERDICT:" + json.dumps(verdict))
+sys.exit(0 if verdict.get("ok") and rc == 0 else 1)
+"""
+
+
+def scenario_prefork_kill() -> int:
+    """2-process SO_REUSEPORT serving under load: both workers answer,
+    one is SIGKILLed mid-load, the supervisor restarts it in its slot
+    (new pid), no request fails after one retry — and the per-slot
+    writer identities keep epoch-named tile files collision-free."""
+    # the process half: kill + restart + retry, in a fresh interpreter
+    # (the parent must fork its workers before anything imports jax)
+    p = subprocess.run([sys.executable, "-c", _PREFORK_SCRIPT],
+                       cwd=REPO, capture_output=True, text=True,
+                       timeout=600)
+    lines = [ln for ln in p.stdout.splitlines()
+             if ln.startswith("VERDICT:")]
+    if p.returncode != 0 or not lines:
+        return fail(f"prefork service leg rc={p.returncode}: "
+                    f"{(p.stdout + p.stderr)[-2000:]}")
+    verdict = json.loads(lines[-1][len("VERDICT:"):])
+    log(f"prefork_kill: workers {verdict['tags']} -> SIGKILL p0 -> "
+        f"restarted as {verdict['new_tag']} "
+        f"({verdict['retried']} request(s) needed their one retry)")
+
+    # the identity half: two workers sharing one sink must never emit
+    # colliding epoch tile names — each slot's writer id is distinct,
+    # and a RESTARTED slot reuses its id so committed-epoch markers
+    # dedupe its re-emits instead of a new id duplicating tiles
+    from reporter_tpu.service.prefork import writer_id_for_slot
+    from reporter_tpu.streaming.anonymiser import Anonymiser, TileSink
+    with tempfile.TemporaryDirectory() as tmp:
+        names = {}
+        for slot in range(2):
+            os.environ["REPORTER_TPU_WRITER_ID"] = \
+                writer_id_for_slot(slot, base="")
+            try:
+                a = Anonymiser(TileSink(os.path.join(tmp, "out")),
+                               privacy=1, quantisation=3600,
+                               source="chaos")
+            finally:
+                os.environ.pop("REPORTER_TPU_WRITER_ID", None)
+            names[slot] = a.epoch_file_name(0)
+        if names[0] == names[1]:
+            return fail(f"slot writer ids collide: {names}")
+    log(f"prefork_kill ok: epoch file names per slot {names}")
+    return 0
+
+
 SCENARIOS = {
     "storm": scenario_storm,
     "kill_restore": scenario_kill_restore,
+    "prefork_kill": scenario_prefork_kill,
     "submit_burst": scenario_submit_burst,
     "egress_outage": scenario_egress_outage,
     "decode_poison": scenario_decode_poison,

@@ -150,6 +150,67 @@ def gate(candidate: dict, entries: List[dict], tolerance: float,
     return (not verdict["failures"]), verdict
 
 
+def gate_shares_absolute(candidate: dict, max_shares: dict
+                         ) -> Tuple[bool, dict]:
+    """Absolute per-stage share ceilings (``--max-share report=0.2``):
+    the median gate only catches REGRESSIONS vs history — a ceiling
+    pins a stage's share below a hard target (ISSUE 11: the native
+    wire writer must hold the serialized ``report`` share at or below
+    its acceptance number, not merely match the ledger median)."""
+    shares = candidate.get("stage_shares") or {}
+    verdict: dict = {"candidate": {"source": candidate.get("source"),
+                                   "stage_shares": shares},
+                     "max_shares": max_shares, "failures": []}
+    for stage, ceil in max_shares.items():
+        got = shares.get(stage)
+        if got is None:
+            verdict["failures"].append(
+                {"check": "max_share", "stage": stage,
+                 "reason": f"candidate records no {stage!r} share to "
+                 "hold under the ceiling"})
+        elif got > ceil:
+            verdict["failures"].append(
+                {"check": "max_share", "stage": stage, "candidate": got,
+                 "ceiling": ceil,
+                 "reason": f"{stage} share {got} exceeds the hard "
+                 f"ceiling {ceil}"})
+    return (not verdict["failures"]), verdict
+
+
+def gate_multichip(path: str, min_ratio: float) -> Tuple[bool, dict]:
+    """Gate a tools/multichip_bench.py artifact: every leg ran, ratios
+    were measured, and no device count fell below ``min_ratio`` x the
+    1-device throughput (on a CPU box the virtual mesh shards compute-
+    bound work over the same cores, so the default floor only catches
+    a catastrophic sharding regression; raise it on real hardware)."""
+    with open(path, encoding="utf-8") as f:
+        art = json.load(f)
+    ratios = art.get("ratios") or {}
+    verdict = {
+        "candidate": {"source": os.path.basename(path),
+                      "kind": "multichip",
+                      "n_devices": art.get("n_devices")},
+        "ratios": ratios, "min_ratio": min_ratio, "failures": [],
+    }
+    if not art.get("ok"):
+        verdict["failures"].append(
+            {"check": "multichip", "reason": "artifact reports ok=false "
+             f"(tail: {art.get('tail', '')[:120]})"})
+    if not ratios:
+        verdict["failures"].append(
+            {"check": "multichip", "reason": "artifact carries no "
+             "device-count ratios (legacy liveness-only verdict? "
+             "re-run tools/multichip_bench.py)"})
+    for count, ratio in sorted(ratios.items(), key=lambda kv: int(kv[0])):
+        if ratio < min_ratio:
+            verdict["failures"].append(
+                {"check": "multichip", "n_devices": int(count),
+                 "candidate": ratio, "floor": min_ratio,
+                 "reason": f"{count}-device throughput fell to {ratio}x "
+                 f"the 1-device leg (floor {min_ratio})"})
+    return (not verdict["failures"]), verdict
+
+
 def gate_bigreplay(path: str, min_ratio: float) -> Tuple[bool, dict]:
     """Gate a tools/bigreplay.py artifact: the chaos leg's throughput
     over the clean leg's (same process, same box — a true ratio) must
@@ -199,6 +260,20 @@ def main(argv=None) -> int:
     parser.add_argument("--bigreplay",
                         help="bigreplay artifact: gate the chaos/clean "
                         "throughput ratio against --min-fault-ratio")
+    parser.add_argument("--multichip",
+                        help="multichip_bench artifact: gate every "
+                        "device-count throughput ratio against "
+                        "--min-device-ratio")
+    parser.add_argument("--min-device-ratio", type=float, default=0.5,
+                        help="floor for each N-device over 1-device "
+                        "throughput ratio (default 0.5: a CPU box's "
+                        "virtual mesh shards the same cores; raise on "
+                        "real hardware)")
+    parser.add_argument("--max-share", action="append", default=[],
+                        metavar="STAGE=CEIL",
+                        help="hard absolute ceiling on a candidate "
+                        "stage share (repeatable), e.g. report=0.2 — "
+                        "checked in addition to the median gate")
     parser.add_argument("--min-fault-ratio", type=float, default=0.4,
                         help="floor for the bigreplay chaos-over-clean "
                         "throughput ratio (default 0.4 — small smoke "
@@ -216,9 +291,32 @@ def main(argv=None) -> int:
                         "comparable entries exist")
     args = parser.parse_args(argv)
 
+    max_shares = {}
+    for spec in args.max_share:
+        try:
+            stage, ceil = spec.split("=", 1)
+            max_shares[stage.strip()] = float(ceil)
+        except ValueError:
+            parser.error(f"--max-share wants STAGE=CEIL, got {spec!r}")
+    if max_shares and (args.bigreplay or args.multichip):
+        # those artifacts carry no stage shares — refuse loudly rather
+        # than silently ignoring a ceiling the caller believes binds
+        parser.error("--max-share applies to --candidate/--self-check "
+                     "runs only")
+
     if args.bigreplay:
         passed, verdict = gate_bigreplay(args.bigreplay,
                                          args.min_fault_ratio)
+        verdict["pass"] = passed
+        print(json.dumps(verdict, separators=(",", ":")))
+        if not passed:
+            for f in verdict["failures"]:
+                sys.stderr.write(f"perf_gate: FAIL: {f['reason']}\n")
+        return 0 if passed else 1
+
+    if args.multichip:
+        passed, verdict = gate_multichip(args.multichip,
+                                         args.min_device_ratio)
         verdict["pass"] = passed
         print(json.dumps(verdict, separators=(",", ":")))
         if not passed:
@@ -253,6 +351,12 @@ def main(argv=None) -> int:
         parser.error("need --candidate FILE, --self-check or "
                      "--bigreplay FILE")
         return 2  # unreachable; parser.error exits
+
+    if max_shares:  # absolute ceilings, on top of the median gate
+        abs_ok, abs_verdict = gate_shares_absolute(candidate, max_shares)
+        verdict["max_shares"] = abs_verdict["max_shares"]
+        verdict["failures"].extend(abs_verdict["failures"])
+        passed = passed and abs_ok
 
     verdict["pass"] = passed
     print(json.dumps(verdict, separators=(",", ":")))

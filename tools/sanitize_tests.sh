@@ -20,7 +20,10 @@ set -u
 ROOT="$(cd "$(dirname "$0")/.." && pwd)"
 NATIVE="$ROOT/reporter_tpu/native"
 CXX="${CXX:-g++}"
-TESTS="tests/test_native.py tests/test_native_batch.py tests/test_prep_v2.py"
+TESTS="tests/test_native.py tests/test_native_batch.py tests/test_prep_v2.py tests/test_report_writer.py"
+# test_report_writer drives the ABI-12 wire writers (per-trace +
+# whole-chunk emission, parity + slicing) under the sanitizer
+# builds with the same 2-thread prep pool
 MODE="${1:-default}"
 
 probe() {

@@ -171,6 +171,90 @@ def main() -> int:
         return fail(f"route-memo cross-call reuse broken ({s1} -> {s2})")
     log("route-memo cross-call reuse: hit path exercised")
 
+    # -- leg 6: wire writer storm (ISSUE 11) --------------------------------
+    # Many Python threads serialise /report bodies from ONE shared
+    # chunk's RunColumns concurrently — the GIL-released per-trace and
+    # whole-chunk C calls read the shared columns (and the cached
+    # packed-pointer array) at the same time, and threads race the
+    # chunk-memo build (the benign last-writer-wins documented in
+    # service/wire.py) — exactly the serving pattern under
+    # BoundedThreadingHTTPServer. The columns are SYNTHESISED here (no
+    # decode: jax under a preloaded libtsan is the deadlock this driver
+    # exists to avoid); byte parity with the single-threaded Python
+    # writer rides along.
+    from reporter_tpu.matcher.matcher import MatchRuns, RunColumns
+    from reporter_tpu.service.report import _report_json_py, report_wire
+
+    wrng = np.random.default_rng(17)
+    n_traces_w, runs_per = 16, 6
+    n_runs = n_traces_w * runs_per
+    starts = np.round(1.5e9 + np.cumsum(
+        wrng.uniform(1.0, 9.0, n_runs)), 3)
+    ends = np.round(starts + wrng.uniform(0.5, 6.0, n_runs), 3)
+    starts[::17] = -1.0  # sentinel rows, like real discontinuities
+    ends[::17] = -1.0
+    seg_id = wrng.integers(0, 1 << 40, n_runs).astype(np.int64)
+    seg_id[::5] = -1  # unassociated rows
+    n_ways = 2 * n_runs
+    runs_dict = {
+        "seg_id": seg_id,
+        "internal": (wrng.random(n_runs) < 0.15).astype(np.uint8),
+        "start": starts, "end": ends,
+        "length": wrng.integers(5, 900, n_runs).astype(np.int32),
+        "queue": wrng.integers(0, 60, n_runs).astype(np.int32),
+        "begin_idx": np.arange(n_runs, dtype=np.int32),
+        "end_idx": np.arange(1, n_runs + 1, dtype=np.int32),
+        "way_off": np.arange(0, n_ways + 1, 2,
+                             dtype=np.int64)[:n_runs + 1],
+        "ways": wrng.integers(1, 1 << 30, n_ways).astype(np.int64),
+    }
+    wcols = RunColumns(runs_dict)
+    run_off = np.arange(0, n_runs + 1, runs_per, dtype=np.int64)
+    t_ends = np.round(
+        np.array([starts[min(hi, n_runs) - 1] + 30.0
+                  for hi in run_off[1:]]), 3)
+    wcols.arrays["_run_off"] = run_off
+    wcols.arrays["_trace_end"] = np.ascontiguousarray(t_ends,
+                                                      np.float64)
+    runs = []
+    for t in range(n_traces_w):
+        mr = MatchRuns(wcols, int(run_off[t]), int(run_off[t + 1]),
+                       "auto")
+        rq = {"uuid": f"wire-{t}",
+              "trace": [{"time": float(t_ends[t])}]}
+        runs.append((mr, rq))
+    want = [_report_json_py(mm, rq, 15, {0, 1, 2}, {0, 1, 2})
+            .encode("utf-8") for mm, rq in runs]
+    wire_errors: list = []
+
+    def wire_storm(rounds: int) -> None:
+        try:
+            for _ in range(rounds):
+                # force fresh chunk-memo builds so threads race the
+                # whole-chunk C emission, not just memo reads
+                wcols.arrays.pop("_wire_chunk", None)
+                for (mm, rq), exp in zip(runs, want):
+                    got = report_wire(mm, rq, 15, {0, 1, 2}, {0, 1, 2})
+                    if bytes(got) != exp:
+                        raise AssertionError(
+                            f"wire bytes diverged for {rq['uuid']}")
+        except BaseException as e:
+            wire_errors.append(e)
+
+    wthreads = [threading.Thread(target=wire_storm, args=(6,))
+                for _ in range(4)]
+    for t in wthreads:
+        t.start()
+    for t in wthreads:
+        t.join()
+    if wire_errors:
+        return fail(f"wire writer storm: {wire_errors[0]}")
+    from reporter_tpu.utils import metrics
+    if metrics.counter("wire.native") <= 0:
+        return fail("wire writer storm never took the native backend")
+    log(f"wire writer storm: 4 threads x 6 rounds over {len(runs)} "
+        f"traces, byte parity held")
+
     log("clean: all legs passed under the tsan build")
     return 0
 
