@@ -45,7 +45,10 @@ ENV_KNOBS: Dict[str, str] = {
     "REPORTER_TPU_WIRE_NATIVE": "/report wire writer: auto|off",
     "REPORTER_TPU_SERVICE_PROCS": "pre-fork service worker count",
     "REPORTER_TPU_SHARD": "multi-device mesh decode on/off",
+    "REPORTER_TPU_DECODE_SHARD": "decode mesh: auto|on|off",
+    "REPORTER_TPU_DEVICE_SLICE": "this process's local-device subset",
     "REPORTER_TPU_SEQ_SHARDS": "sequence-parallel time-axis shards",
+    "REPORTER_TPU_BUCKETS": "bucket ladder [+ @waste split threshold]",
     "REPORTER_TPU_COORDINATOR": "jax.distributed rendezvous address",
     "REPORTER_TPU_NUM_PROCESSES": "jax.distributed process count",
     "REPORTER_TPU_PROCESS_ID": "jax.distributed process id",
@@ -159,6 +162,8 @@ METRICS: Dict[str, str] = {
     "decode.dispatch.first": "compiling-dispatch wall (timer)",
     "decode.dispatch.steady": "steady-state dispatch wall (timer)",
     "decode.occupancy.*": "per-bucket occupancy ratio histograms",
+    "decode.shard.*": "mesh-path decode chunks + rows fanned across it",
+    "decode.bucket.split": "chunks split into finer pow2 sub-buckets",
     "decode.shadow.chunks": "chunks shadow-decoded via the numpy oracle",
     "decode.shadow.sampled": "traces shadow-decoded via the numpy oracle",
     "decode.shadow.mismatch": "shadow decodes scoring off the oracle",

@@ -18,6 +18,7 @@ fixed-shape and branch-free (SURVEY.md §7 "Hard parts: raggedness"):
 from __future__ import annotations
 
 import bisect
+import os as _os
 from dataclasses import dataclass
 from typing import List, Sequence
 
@@ -34,11 +35,68 @@ from .params import MatchParams
 
 LENGTH_BUCKETS = (16, 64, 256, 1024)
 
+#: runtime bucket-ladder override: "16,64,256,1024" (ascending ints),
+#: with an optional "@<waste>" suffix setting the occupancy-driven
+#: split threshold ("@1" / "@off" disables splitting). Default: the
+#: fixed LENGTH_BUCKETS ladder with splitting at DEFAULT_SPLIT_WASTE.
+ENV_BUCKETS = "REPORTER_TPU_BUCKETS"
+
+#: padding-waste ratio above which the native dispatcher breaks a
+#: mixed-length chunk into per-pow2-bucket sub-batches (matcher.py
+#: SegmentMatcher._split_bucket) — high enough that the exact-fill steady state
+#: (BENCH_DEV_r07 recorded 0.21 whole-run, mostly jitter drops and
+#: pow2 row padding a finer T can't reclaim) never splits, low enough
+#: that a 17-point trace padding to T=64 (waste ~0.73) always does
+DEFAULT_SPLIT_WASTE = 0.35
+
+_ladder_cache: "dict[str, tuple]" = {}
+
+
+def bucket_ladder() -> "tuple[tuple, float]":
+    """(ladder, split_threshold) from REPORTER_TPU_BUCKETS; the default
+    fixed ladder with the default threshold when unset. A malformed
+    spec logs and keeps the default (a typo'd ladder must degrade to
+    the shipped shapes, never to an unbounded shape zoo)."""
+    spec = _os.environ.get(ENV_BUCKETS, "").strip()
+    if not spec:
+        # the default is NOT cached: LENGTH_BUCKETS is read live, so
+        # tests that monkeypatch the module ladder keep working
+        return LENGTH_BUCKETS, DEFAULT_SPLIT_WASTE
+    got = _ladder_cache.get(spec)
+    if got is not None:
+        return got
+    ladder, thresh = LENGTH_BUCKETS, DEFAULT_SPLIT_WASTE
+    if spec:
+        body, _, tail = spec.partition("@")
+        try:
+            if tail.strip().lower() in ("off", "no", "false"):
+                thresh = 1.0
+            elif tail.strip():
+                thresh = float(tail)
+            vals = tuple(int(v) for v in body.split(",") if v.strip())
+            if body.strip():
+                if not vals or any(v <= 0 for v in vals) or \
+                        list(vals) != sorted(set(vals)):
+                    raise ValueError("ladder must be ascending positive")
+                ladder = vals
+            if not 0.0 < thresh:
+                raise ValueError("threshold must be positive")
+        except ValueError as e:
+            import logging
+            logging.getLogger("reporter_tpu.matcher").warning(
+                "%s=%r not understood (%s); keeping the default ladder",
+                ENV_BUCKETS, spec, e)
+            ladder, thresh = LENGTH_BUCKETS, DEFAULT_SPLIT_WASTE
+    _ladder_cache[spec] = (ladder, thresh)
+    return ladder, thresh
+
 
 def bucket_length(n: int) -> int:
-    """Smallest bucket >= n (the last bucket caps the trace length)."""
-    idx = bisect.bisect_left(LENGTH_BUCKETS, n)
-    return LENGTH_BUCKETS[min(idx, len(LENGTH_BUCKETS) - 1)]
+    """Smallest bucket >= n (the last bucket caps the trace length).
+    Reads the runtime ladder (REPORTER_TPU_BUCKETS; default unchanged)."""
+    ladder, _ = bucket_ladder()
+    idx = bisect.bisect_left(ladder, n)
+    return ladder[min(idx, len(ladder) - 1)]
 
 
 def kept_point_count(batch: "PaddedBatch") -> int:

@@ -32,7 +32,7 @@ from ..utils import faults, metrics
 from ..utils import locks as _locks
 from ..utils.circuit import CircuitBreaker
 from .assemble import assemble_segments
-from .batchpad import (LENGTH_BUCKETS, kept_point_count, pack_batches,
+from .batchpad import (bucket_ladder, kept_point_count, pack_batches,
                        padded_batch_rows, prepare_batch, prepare_trace,
                        prepare_traces_numpy)
 from .params import MatchParams
@@ -69,14 +69,36 @@ def _decode_chunk() -> int:
     without real overlap, so fewer dispatches win (+17% measured on one
     core at 512 vs 128) until per-chunk tensors (route_m: 16 MB f32 at
     512) outgrow cache and memory bandwidth takes it back (1024-row
-    chunks measured ~10% SLOWER than 512)."""
+    chunks measured ~10% SLOWER than 512). The default then scales by
+    the decode mesh's data-axis width: a chunk is split across all M
+    devices, so per-DEVICE rows (and therefore per-device utilisation)
+    only hold steady if the chunk grows with the mesh."""
     from ..utils.runtime import _env_int
     val = _env_int("REPORTER_TPU_DECODE_CHUNK", 0)
     if val:
         return max(1, val)
     if pipeline_enabled() and (os.cpu_count() or 1) > 1:
-        return 128
-    return 512
+        base = 128
+    else:
+        base = 512
+    from ..ops import decode_mesh_size
+    return base * max(1, decode_mesh_size())
+
+
+def match_batch_default() -> int:
+    """Default dispatcher flush cap (service MATCH_BATCH_MAX unset): at
+    least TWO decode chunks per drained batch, so the dispatch lane
+    keeps >=2 chunks in flight per device while the drain lane works —
+    a chunk spans the whole data mesh, so 2x the chunk is 2 chunks per
+    device. PR 8's queue-depth wide events are the sensor proving the
+    devices stay fed under this depth. Unsharded hosts keep the
+    shipped 256: the scaling rationale is mesh utilisation, and
+    quadrupling the flush cap on a single lone-CPU device would only
+    grow tail latency and peak memory."""
+    from ..ops import decode_mesh_size
+    if decode_mesh_size() <= 1:
+        return 256
+    return max(256, 2 * _decode_chunk())
 
 
 def _prep_workers() -> int:
@@ -417,6 +439,14 @@ class SegmentMatcher:
             max_workers=1, thread_name_prefix="device-dispatch")
         self._drain_pool = ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="device-drain")
+        # build the process-global decode mesh NOW (not on the first
+        # request): device enumeration + the sharded jit wrappers are
+        # one-time costs that belong at init, and a mis-sliced
+        # REPORTER_TPU_DEVICE_SLICE should fail loudly here. None when
+        # sharding is off or only one local device is visible
+        # (REPORTER_TPU_DECODE_SHARD, default auto).
+        from ..parallel import mesh as _pmesh
+        self.decode_mesh = _pmesh.decode_mesh()
 
     @property
     def grid(self) -> SpatialGrid:
@@ -830,7 +860,7 @@ class SegmentMatcher:
         chunks skip native entirely until a half-open probe succeeds.
         """
         workers = max(1, _prep_workers())
-        buckets = np.asarray(LENGTH_BUCKETS, dtype=np.int64)
+        buckets = np.asarray(bucket_ladder()[0], dtype=np.int64)
         raw_counts = np.diff(tb.offsets)  # per-trace raw point counts
         # bucket by RAW length (kept length is only known after the
         # native prep; raw is an upper bound, so a jitter-heavy trace
@@ -843,56 +873,132 @@ class SegmentMatcher:
         for params, idxs in self._param_groups(per_trace_params):
             sigma = np.float32(params.effective_sigma)
             beta = np.float32(params.beta)
-            for T in np.unique(Ts[idxs]).tolist():
-                bucket = idxs[Ts[idxs] == T]
-                for lo in range(0, len(bucket), chunk):
-                    part = bucket[lo:lo + chunk]
-                    # part itself is the order: _drain_stage only
-                    # enumerates it, so no per-chunk list conversion
-                    # (reporter-lint HP003)
-                    order = part
-                    rows = padded_batch_rows(len(part), pad)
-                    with obs_trace.span("matcher.chunk", chunk=ci,
-                                        traces=len(part), T=int(T)):
-                        ci += 1
-                        if not self.circuit.allow():
-                            metrics.count(
-                                "matcher.circuit.fallback_chunks")
-                            self._submit_numpy_chunk(tb, part, params,
-                                                     pad, submit, sigma,
-                                                     beta)
-                            continue
-                        try:
-                            with metrics.timer("matcher.prep"):
-                                faults.failpoint("native.prep")
-                                batch = prepare_batch(
-                                    self.runtime, tb.gather(part),
-                                    params, int(T), pad_rows=rows,
-                                    n_threads=workers)
-                        except Exception as e:
-                            self.circuit.record_failure()
-                            metrics.count("matcher.circuit.native_errors")
-                            logger.warning(
-                                "native prep failed for a %d-trace chunk "
-                                "(%s); serving it via the numpy fallback",
-                                len(part), e)
-                            self._submit_numpy_chunk(tb, part, params,
-                                                     pad, submit, sigma,
-                                                     beta)
-                            continue
-                        self.circuit.record_success()
-                        # the chunk's wide event: occupancy vs the
-                        # padded (rows, T) grid, memo state, queue
-                        # depth — one call per CHUNK, not per trace
-                        profiler.chunk_event(
-                            bucket_T=int(T), K=params.max_candidates,
-                            traces=len(part),
-                            rows=int(batch.case.shape[0]),
-                            kept_points=kept_point_count(batch),
-                            raw_points=int(raw_counts[part].sum()),
-                            cache=self.runtime.route_memo_stats(),
-                            path="native")
-                        submit(batch, order, sigma, beta)
+            for T0 in np.unique(Ts[idxs]).tolist():
+                group = idxs[Ts[idxs] == T0]
+                for T, bucket in self._split_bucket(int(T0), group,
+                                                    raw_counts, pad,
+                                                    chunk):
+                    for lo in range(0, len(bucket), chunk):
+                        part = bucket[lo:lo + chunk]
+                        # part itself is the order: _drain_stage only
+                        # enumerates it, so no per-chunk list conversion
+                        # (reporter-lint HP003)
+                        order = part
+                        rows = padded_batch_rows(len(part), pad)
+                        with obs_trace.span("matcher.chunk", chunk=ci,
+                                            traces=len(part), T=int(T)):
+                            ci += 1
+                            if not self.circuit.allow():
+                                metrics.count(
+                                    "matcher.circuit.fallback_chunks")
+                                self._submit_numpy_chunk(
+                                    tb, part, params, pad, submit,
+                                    sigma, beta)
+                                continue
+                            try:
+                                with metrics.timer("matcher.prep"):
+                                    faults.failpoint("native.prep")
+                                    batch = prepare_batch(
+                                        self.runtime, tb.gather(part),
+                                        params, int(T), pad_rows=rows,
+                                        n_threads=workers)
+                            except Exception as e:
+                                self.circuit.record_failure()
+                                metrics.count(
+                                    "matcher.circuit.native_errors")
+                                logger.warning(
+                                    "native prep failed for a %d-trace "
+                                    "chunk (%s); serving it via the "
+                                    "numpy fallback", len(part), e)
+                                self._submit_numpy_chunk(
+                                    tb, part, params, pad, submit,
+                                    sigma, beta)
+                                continue
+                            self.circuit.record_success()
+                            # the chunk's wide event: occupancy vs the
+                            # padded (rows, T) grid, memo state, queue
+                            # depth — one call per CHUNK, not per trace
+                            profiler.chunk_event(
+                                bucket_T=int(T), K=params.max_candidates,
+                                traces=len(part),
+                                rows=int(batch.case.shape[0]),
+                                kept_points=kept_point_count(batch),
+                                raw_points=int(raw_counts[part].sum()),
+                                cache=self.runtime.route_memo_stats(),
+                                path="native")
+                            submit(batch, order, sigma, beta)
+
+    @staticmethod
+    def _padded_cells(n: int, pad, T: int, chunk) -> int:
+        """Point cells ``n`` traces of bucket ``T`` actually decode as,
+        chunked exactly as the dispatch loop chunks them — each chunk
+        re-pays its own mesh-multiple + pow2 row padding."""
+        cells = 0
+        while n > 0:
+            take = min(n, chunk) if chunk else n
+            cells += padded_batch_rows(take, pad) * T
+            n -= take
+        return cells
+
+    @staticmethod
+    def _split_bucket(T: int, group, raw_counts, pad=None, chunk=None):
+        """The occupancy-driven adaptive splitter: ``[(sub_T, index
+        array)]`` for one ladder-bucket group, ``[(T, group)]`` when no
+        split pays. A split breaks a mixed-length group into per-pow2-
+        bucket sub-batches (per-trace smallest power of two >= raw
+        length, clipped to [ladder floor, T]) when the padding waste of
+        decoding everything at T exceeds the ladder's threshold —
+        consulting the RECORDED per-bucket waste from PR 8's wide
+        events (profiler.bucket_waste) once chunks of this T have been
+        measured, and a projection from this group's raw lengths before
+        that (kept <= raw, so the projection under-states waste and
+        never over-splits). ``pad`` is the mesh row multiple: a split
+        only happens when the total padded point cells ACROSS the
+        sub-batches — each re-paying mesh-multiple + pow2 ROW padding —
+        actually drop, so splitting can never trade tail pad for worse
+        filler-row pad (a 4-trace sub-batch on an 8-wide mesh pads
+        right back to 8 rows). Decoded paths are unchanged — the SKIP
+        tail is inert, pinned byte-identical by
+        tests/test_sharded_decode.py — and the shape cost is bounded:
+        sub-buckets are powers of two, each new (rows, T) pair is ONE
+        compile episode, and a second compile of the same shape still
+        trips the storm counter."""
+        ladder, thresh = bucket_ladder()
+        if thresh >= 1.0 or len(group) < 2 or T <= int(ladder[0]):
+            return [(T, group)]
+        raws = np.minimum(raw_counts[group], T)
+        # decision waste = max(projected, recorded). The projection
+        # uses the same denominator the recorded waste does — PADDED
+        # rows chunked exactly as dispatch will chunk them (mesh
+        # multiple + pow2 filler counts as waste there too) — with
+        # kept <= raw in the numerator, so it under-states and never
+        # over-splits; the recorded per-bucket number (PR 8's wide
+        # events) catches what the projection can't see (kept << raw
+        # on jitter-heavy streams). max, not recorded-first: after a
+        # split, the low-waste SUB-chunks record under this same T
+        # and a recorded-first read would oscillate
+        # (split -> record low -> stop splitting -> record high -> ...)
+        cells_unsplit = SegmentMatcher._padded_cells(len(group), pad, T,
+                                                     chunk)
+        waste = 1.0 - float(raws.sum()) / cells_unsplit
+        recorded = profiler.bucket_waste(T)
+        if recorded is not None:
+            waste = max(waste, recorded)
+        if waste <= thresh:
+            return [(T, group)]
+        subTs = np.minimum(np.maximum(
+            np.exp2(np.ceil(np.log2(np.maximum(raws, 1))))
+            .astype(np.int64), int(ladder[0])), T)
+        uniq, counts = np.unique(subTs, return_counts=True)
+        if uniq.tolist() == [T]:
+            return [(T, group)]
+        cells_split = int(sum(
+            SegmentMatcher._padded_cells(int(c), pad, int(s), chunk)
+            for s, c in zip(uniq.tolist(), counts.tolist())))
+        if cells_split >= cells_unsplit:
+            return [(T, group)]
+        metrics.count("decode.bucket.split")
+        return [(int(s), group[subTs == s]) for s in uniq.tolist()]
 
     def _submit_numpy_chunk(self, tb: TraceBatch, part, params, pad,
                             submit, sigma, beta) -> None:

@@ -91,7 +91,7 @@ def entry_from_bench(parsed: dict, source: str, label: str, kind: str,
     base_n = (baseline.get("n_traces")
               if isinstance(baseline.get("n_traces"), int) else None)
     scope = "smoke" if base_n is not None and base_n < 64 else "full"
-    return {
+    entry = {
         "source": source,
         "label": label,
         "kind": kind,
@@ -107,6 +107,12 @@ def entry_from_bench(parsed: dict, source: str, label: str, kind: str,
         "ok": parsed.get("vs_baseline") is not None,
         "context": context,
     }
+    # the adaptive-bucket before/after pair (ISSUE 13): fixed-ladder vs
+    # adaptive padding waste over the same mixed-length batch — a true
+    # same-box ratio pair, gated by perf_gate --max-padding-waste
+    if isinstance(parsed.get("bucketing"), dict):
+        entry["bucketing"] = parsed["bucketing"]
+    return entry
 
 
 def _failed_entry(source: str, label: str, kind: str, tail: str) -> dict:
@@ -120,34 +126,46 @@ def _failed_entry(source: str, label: str, kind: str, tail: str) -> dict:
 
 
 def _multichip_entry(source: str, d: dict) -> dict:
-    """One ledger entry from a MULTICHIP artifact. Legacy artifacts
-    carry only the liveness verdict; tools/multichip_bench.py ones add
-    per-device-count legs and throughput ratios — ``vs_baseline`` then
-    holds the max-device-count ratio over the 1-device leg (a true
-    same-box ratio, like every other entry) and ``traces_per_sec`` the
-    max-device leg's absolute, with the full ratio curve in context.
-    Gate with ``tools/perf_gate.py --multichip`` (the kind is excluded
-    from the bench comparable pool, so these ratios never bleed into
-    the vs_baseline medians)."""
+    """One ledger entry from a MULTICHIP artifact. r01-r05 carry only
+    ``ok: true`` — a liveness verdict with no measurement — and are
+    tagged ``scope: legacy`` so no gate median ever pools them with
+    measured runs (the like-for-like pool starts at the first artifact
+    whose legs assert ``devices_seen``); tools/multichip_bench.py
+    artifacts add per-device-count legs and throughput ratios —
+    ``vs_baseline`` then holds the max-device-count ratio over the
+    1-device leg (a true same-box ratio, like every other entry) and
+    ``traces_per_sec`` the max-device leg's absolute, with the full
+    ratio curve in context. A measured artifact whose legs never saw
+    their requested device count (the r06 failure mode) is also tagged
+    legacy: its ratios compare nothing. Gate with ``tools/perf_gate.py
+    --multichip`` (the kind is excluded from the bench comparable pool,
+    so these ratios never bleed into the vs_baseline medians)."""
     ratios = d.get("ratios") or {}
     legs = d.get("legs") or []
     top = max((leg for leg in legs
                if leg.get("traces_per_sec")),
               key=lambda leg: leg["n_devices"], default=None)
     vs = ratios.get(str(d.get("n_devices"))) if ratios else None
+    measured = bool(ratios) and all(
+        leg.get("devices_seen") == leg.get("n_devices") for leg in legs)
     context = None
     if ratios:
         context = "device ratios vs 1: " + ",".join(
             f"{k}x={v}" for k, v in sorted(ratios.items(),
                                            key=lambda kv: int(kv[0])))
+        if not measured:
+            context += ("; LEGACY: legs disagree with their requested "
+                        "device counts (devices_seen) — ratios compare "
+                        "nothing")
     elif not d.get("ok"):
         context = f"rc={d.get('rc')}; harness leg failed or timed out"
     return {"source": source,
             "label": source.replace("MULTICHIP_", "").replace(".json",
                                                               ""),
-            "kind": "multichip", "scope": "full",
+            "kind": "multichip",
+            "scope": "full" if measured else "legacy",
             "platform": None, "decode": None, "pipelined": None,
-            "vs_baseline": vs,
+            "vs_baseline": vs if measured else None,
             "traces_per_sec": top["traces_per_sec"] if top else None,
             "baseline_tps": None, "stage_shares": None,
             "n_devices": d.get("n_devices"), "ok": bool(d.get("ok")),

@@ -95,6 +95,9 @@ _platform_cache: Optional[str] = None
 _queue_depth = 0          # last depth noted by the dispatcher
 _total_kept = 0           # running occupancy totals (point slots)
 _total_cells = 0
+#: per-bucket-T running [kept, cells] — the recorded waste the adaptive
+#: bucket splitter acts on (SegmentMatcher._split_bucket)
+_bucket_totals: Dict[int, list] = {}
 _compile_episodes = 0
 
 _shadow_acc = 0.0         # deterministic sampling accumulator
@@ -176,16 +179,18 @@ class _DispatchSpan:
             return False
         calls, compile_s = self._acc
         compiled = calls > 0
-        # the backend is part of the compiled-shape identity: switching
-        # REPORTER_TPU_DECODE (bench's pallas leg, an operator A/B)
-        # legitimately compiles the same (B, T, K) again and must not
-        # read as a recompile storm
+        # the backend AND the mesh width are part of the compiled-shape
+        # identity: switching REPORTER_TPU_DECODE (bench's pallas leg,
+        # an operator A/B) — or a (B, T, K) that recompiles because the
+        # decode mesh changed (device slice, DECODE_SHARD flip) — is a
+        # new shape, not a recompile storm
         try:
-            from ..ops import decode_backend
+            from ..ops import decode_backend, shard_width
             backend = decode_backend(self.T, self.K)
+            mesh = shard_width(self.B, self.T, backend)
         except Exception:  # pragma: no cover - ops is always importable
-            backend = "?"
-        key = (self.B, self.T, self.K, _platform(), backend)
+            backend, mesh = "?", 1
+        key = (self.B, self.T, self.K, _platform(), backend, mesh)
         global _compile_episodes
         with _lock:
             st = _shapes.get(key)
@@ -193,6 +198,7 @@ class _DispatchSpan:
                 st = _shapes[key] = {
                     "B": self.B, "T": self.T, "K": self.K,
                     "platform": key[3], "backend": backend,
+                    "mesh": mesh,
                     "dispatches": 0, "compiles": 0,
                     "compile_calls": 0, "compile_s": 0.0,
                     "first_s": elapsed, "steady_n": 0,
@@ -219,10 +225,11 @@ class _DispatchSpan:
                 metrics.count("decode.compile.recompiles")
                 logger.warning(
                     "recompile storm: decode shape B=%d T=%d K=%d "
-                    "(%s/%s) compiled again (%d episodes, %.0f ms this "
-                    "time) — a steady-state service should compile "
-                    "each shape once", self.B, self.T, self.K, key[3],
-                    backend, st["compiles"], compile_s * 1e3)
+                    "(%s/%s mesh=%d) compiled again (%d episodes, "
+                    "%.0f ms this time) — a steady-state service "
+                    "should compile each shape once", self.B, self.T,
+                    self.K, key[3], backend, mesh, st["compiles"],
+                    compile_s * 1e3)
         else:
             metrics.observe("decode.dispatch.steady", elapsed)
         return False
@@ -292,6 +299,11 @@ def chunk_event(bucket_T: int, K: int, traces: int, rows: int,
         _events.extend((event,))
         _total_kept += int(kept_points)
         _total_cells += int(cells)
+        tot = _bucket_totals.get(int(bucket_T))
+        if tot is None:
+            tot = _bucket_totals[int(bucket_T)] = [0, 0]
+        tot[0] += int(kept_points)
+        tot[1] += int(cells)
     metrics.count("profile.chunks")
     # per-bucket occupancy histogram: the ratio rides the fixed
     # log-bucket timer machinery (units are ratio, not seconds) so
@@ -317,6 +329,17 @@ def padding_waste() -> Optional[float]:
         if not _total_cells:
             return None
         return 1.0 - _total_kept / _total_cells
+
+
+def bucket_waste(bucket_T: int) -> Optional[float]:
+    """Recorded padding-waste ratio for one bucket shape — what the
+    dispatcher's adaptive splitter consults before breaking a chunk
+    into finer sub-buckets; None before the first chunk of that T."""
+    with _lock:
+        tot = _bucket_totals.get(int(bucket_T))
+        if not tot or not tot[1]:
+            return None
+        return 1.0 - tot[0] / tot[1]
 
 
 def compile_count() -> int:
@@ -503,6 +526,7 @@ def _shape_view(st: dict) -> dict:
         "B": st["B"], "T": st["T"], "K": st["K"],
         "platform": st["platform"],
         "backend": st["backend"],
+        "mesh": st.get("mesh", 1),
         "dispatches": st["dispatches"],
         "compiles": st["compiles"],
         "compile_calls": st["compile_calls"],
@@ -548,6 +572,7 @@ def reset() -> None:
         _events
     with _lock:
         _shapes.clear()
+        _bucket_totals.clear()
         _queue_depth = 0
         _total_kept = 0
         _total_cells = 0

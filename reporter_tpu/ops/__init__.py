@@ -29,7 +29,16 @@ from .pallas_viterbi import (
 )
 
 __all__ = ["viterbi_assoc_batch", "viterbi_pallas_batch", "step_matrices",
-           "decode_batch", "batch_pad_multiple"]
+           "decode_batch", "batch_pad_multiple", "decode_mesh_size",
+           "shard_width"]
+
+# a forked worker must re-derive its device slice and jitted runs (the
+# parent's mesh names devices the child's slice may not own); prefork
+# builds everything post-fork anyway — this keeps it true even for a
+# parent that decoded before forking
+from ..utils import forksafe as _forksafe  # noqa: E402
+
+_forksafe.register(lambda: reset_sharded_cache())
 
 
 def decode_backend(T: int, K: int) -> str:
@@ -40,68 +49,110 @@ def decode_backend(T: int, K: int) -> str:
         return forced
     # default is platform-aware: assoc's max-plus matmuls buy log-depth
     # and seq-shardability at O(K^3) work — the right trade on an
-    # accelerator or a device mesh, and a 4x throughput LOSS on a lone
-    # CPU device where the T-step dependence chain costs nothing
-    # (measured: 512 traces decode ~59 ms scan vs ~244 ms assoc on one
-    # CPU core). Single-device CPU -> scan; everything else -> assoc.
-    if jax.default_backend() == "cpu" and len(jax.local_devices()) == 1:
-        return "scan"
+    # accelerator, or on any mesh that shards the time axis. On CPU the
+    # T-step dependence chain costs nothing and assoc is a measured ~4x
+    # decode loss (512 traces: ~59 ms scan vs ~244 ms assoc on one
+    # core) — and since the 1-D ("data",) decode mesh shards scan rows
+    # with zero collectives (parallel/sharded.py), a multi-device CPU
+    # mesh keeps scan too; that is also what makes the sharded decode
+    # bit-identical to the single-device oracle.
+    if jax.default_backend() == "cpu":
+        _mesh, _data, seq = _mesh_state()
+        if seq <= 1:
+            return "scan"
     return "assoc"
 
 
-# process-default sharded decode, built lazily on first use: (run, data, seq)
-# or (None, 1, 1) on a single device / when disabled
+# process-default sharded decode, built lazily on first use:
+# (mesh, data, seq, {backend: run}) — (None, 1, 1, {}) when unsharded
 _sharded_cache = None
 
 
-def _sharded_run():
-    """The process-default mesh decode, the production multi-device path.
-
-    Built once from the visible devices: a (data, seq) mesh — data shards
-    the trace batch (the reference's uuid-partition scale-out axis,
-    SURVEY.md §2.4), seq optionally shards the time axis
-    (REPORTER_TPU_SEQ_SHARDS, default 1). REPORTER_TPU_SHARD=0 disables.
-    """
+def _mesh_state():
+    """(mesh, data_size, seq_size) of the process decode mesh
+    (parallel/mesh.py decode_mesh; (None, 1, 1) when single-device or
+    disabled)."""
     global _sharded_cache
     if _sharded_cache is None:
-        if os.environ.get("REPORTER_TPU_SHARD", "1").lower() in (
-                "0", "off", "false"):
-            _sharded_cache = (None, 1, 1)
-            return _sharded_cache
-        # local devices only: in a multi-host job the decode inputs are
-        # host-local numpy arrays, and a device_put onto a global mesh's
-        # non-addressable devices would throw — each process shards over
-        # its own chips; cross-host scale-out stays uuid-partitioned
-        # (parallel/multihost.py), exactly the reference's partition axis
-        n = len(jax.local_devices())
-        if n <= 1:
-            _sharded_cache = (None, 1, 1)
-            return _sharded_cache
-        from ..utils.runtime import _env_int
-        seq = max(1, _env_int("REPORTER_TPU_SEQ_SHARDS", 1))
-        seq = min(seq, n)
-        while n % seq:  # largest feasible seq <= requested
-            seq -= 1
-        data = n // seq
-        from ..parallel.mesh import make_mesh
-        from ..parallel.sharded import sharded_viterbi
-        mesh = make_mesh((data, seq), devices=jax.local_devices())
-        _sharded_cache = (sharded_viterbi(mesh), data, seq)
-    return _sharded_cache
+        from ..parallel import mesh as pmesh
+        mesh = pmesh.decode_mesh()
+        data, seq = pmesh.mesh_axes(mesh)
+        _sharded_cache = (mesh, data, seq, {})
+    return _sharded_cache[:3]
+
+
+def _sharded_run(backend: str):
+    """The mesh decode callable for ``backend``, or None when this
+    backend can't shard on the process mesh (no mesh; pallas; scan on a
+    seq-sharded mesh — the sequential scan has no cross-shard combine)."""
+    global _sharded_cache
+    _mesh_state()  # ensure the cache tuple exists
+    mesh, data, seq, runs = _sharded_cache
+    if mesh is None or backend == "pallas":
+        return None
+    if backend == "scan" and seq > 1:
+        return None
+    run = runs.get(backend)
+    if run is None:
+        from ..parallel.sharded import (sharded_data_viterbi,
+                                        sharded_viterbi)
+        if seq > 1:  # (data, seq) mesh: assoc only (checked above)
+            run = sharded_viterbi(mesh)
+        elif backend == "assoc":
+            run = sharded_data_viterbi(mesh,
+                                       viterbi_assoc_batch.__wrapped__)
+        else:
+            from ..matcher.hmm import viterbi_decode_batch
+            run = sharded_data_viterbi(mesh,
+                                       viterbi_decode_batch.__wrapped__)
+        runs[backend] = run
+    return run
 
 
 def batch_pad_multiple():
     """Batch-dim multiple callers should pad to so ``decode_batch`` can
-    take the sharded path (the mesh's data-axis size); None when decode is
-    single-device. match_many feeds this to pack_batches(pad_batch_to=...).
+    take the sharded path (the mesh's data-axis size); None when decode
+    is single-device. match_many feeds this to
+    pack_batches(pad_batch_to=...) / padded_batch_rows.
 
-    Only the assoc backend shards, so a forced scan/pallas backend means
-    padding would buy nothing — report None and skip it."""
+    scan and assoc both shard along ``data`` (parallel/sharded.py);
+    only a forced pallas backend — and scan under a seq-sharded mesh —
+    can't, so padding would buy nothing there and None skips it."""
     forced = os.environ.get("REPORTER_TPU_DECODE", "").strip().lower()
-    if forced in ("scan", "pallas"):
+    if forced == "pallas":
         return None
-    run, data, _seq = _sharded_run()
-    return data if run is not None else None
+    _mesh, data, seq = _mesh_state()
+    if data <= 1:
+        return None
+    if forced == "scan" and seq > 1:
+        return None
+    return data
+
+
+def shard_width(B: int, T: int, backend: str) -> int:
+    """How many devices a (B, T) decode of ``backend`` actually spans —
+    the compile-shape key's mesh dimension (obs/profiler.py): a
+    recompile because the mesh changed is a new shape, not a storm."""
+    mesh, data, seq = _mesh_state()
+    if _sharded_run(backend) is None or B % data or T % seq:
+        return 1
+    return data * seq
+
+
+def decode_mesh_size() -> int:
+    """Data-axis width of the process decode mesh (1 = unsharded) —
+    what _decode_chunk and the dispatcher's in-flight depth scale by."""
+    _mesh, data, _seq = _mesh_state()
+    return data
+
+
+def reset_sharded_cache() -> None:
+    """Drop the cached mesh + jitted runs (tests re-read the env;
+    forked workers re-derive their device slice)."""
+    global _sharded_cache
+    _sharded_cache = None
+    from ..parallel import mesh as pmesh
+    pmesh.reset_decode_mesh()
 
 
 def decode_batch(dist_m, valid, route_m, gc_m, case, sigma, beta):
@@ -114,20 +165,29 @@ def decode_batch(dist_m, valid, route_m, gc_m, case, sigma, beta):
     way.
 
     With more than one visible device, batches whose dims divide the
-    process mesh run sharded (data-parallel over traces, optionally
-    sequence-parallel over time); others fall through to single-device."""
+    process mesh run sharded — data-parallel over traces for scan and
+    assoc (bit-identical rows, no collectives), optionally sequence-
+    parallel over time for assoc — and the returned paths stay
+    device-sharded until the caller's d2h gather. Others fall through
+    to single-device."""
+    from ..utils import metrics
     backend = decode_backend(T=dist_m.shape[1], K=dist_m.shape[2])
-    if backend == "assoc":
-        run, data, seq = _sharded_run()
+    if backend in ("scan", "assoc"):
+        run = _sharded_run(backend)
+        _mesh, data, seq = _mesh_state()
         B, T = dist_m.shape[0], dist_m.shape[1]
         if run is not None and B % data == 0 and T % seq == 0:
+            # decode.shard.* is the fan-out sensor pair: chunks through
+            # the mesh path, and rows placed across the data axis
+            metrics.count("decode.shard.chunks")
+            metrics.count("decode.shard.rows", B)
             return run(dist_m, valid, route_m, gc_m, case, sigma, beta)
-        return viterbi_assoc_batch(dist_m, valid, route_m, gc_m, case,
-                                   sigma, beta)
-    if backend == "pallas":
-        interpret = jax.default_backend() != "tpu"
-        return viterbi_pallas_batch(dist_m, valid, route_m, gc_m, case,
-                                    sigma, beta, interpret=interpret)
-    from ..matcher.hmm import viterbi_decode_batch
-    return viterbi_decode_batch(dist_m, valid, route_m, gc_m, case,
-                                sigma, beta)
+        if backend == "assoc":
+            return viterbi_assoc_batch(dist_m, valid, route_m, gc_m,
+                                       case, sigma, beta)
+        from ..matcher.hmm import viterbi_decode_batch
+        return viterbi_decode_batch(dist_m, valid, route_m, gc_m, case,
+                                    sigma, beta)
+    interpret = jax.default_backend() != "tpu"
+    return viterbi_pallas_batch(dist_m, valid, route_m, gc_m, case,
+                                sigma, beta, interpret=interpret)

@@ -18,11 +18,36 @@ ride ICI.
 """
 from __future__ import annotations
 
-from typing import Optional, Sequence, Tuple
+import logging
+import os
+import re
+from typing import List, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
 from jax.sharding import Mesh
+
+from ..utils import locks as _locks
+
+logger = logging.getLogger("reporter_tpu.parallel")
+
+#: decode sharding: "auto" (default — shard when >1 local device is
+#: visible), "0"/"off" never, "1"/"on" always (a 1-device mesh is a
+#: no-op). REPORTER_TPU_SHARD=0, the original kill switch, still wins.
+ENV_DECODE_SHARD = "REPORTER_TPU_DECODE_SHARD"
+#: which slice of jax.local_devices() this process decodes on:
+#: "<slot>/<procs>" (slot-derived contiguous block — what the pre-fork
+#: supervisor sets per worker so N processes x M devices never contend
+#: on one device queue) or "<lo>:<hi>" (explicit range). Empty = all.
+ENV_DEVICE_SLICE = "REPORTER_TPU_DEVICE_SLICE"
+
+_SLICE_RE = re.compile(r"^\s*(?:(\d+)\s*/\s*(\d+)|(\d+)?\s*:\s*(\d+)?)\s*$")
+
+# the process-global decode mesh, built once per (shard, slice, seq)
+# env state — a sentinel distinguishes "not built" from "built: None"
+_UNSET = object()
+_mesh_lock = _locks.new_lock("parallel.mesh")
+_decode_mesh = _UNSET
 
 
 def make_mesh(shape: Optional[Tuple[int, int]] = None,
@@ -41,3 +66,130 @@ def make_mesh(shape: Optional[Tuple[int, int]] = None,
         raise ValueError(f"mesh shape {shape} != device count {n}")
     arr = np.asarray(devices).reshape(shape)
     return Mesh(arr, axis_names=tuple(axis_names))
+
+
+def make_data_mesh(devices) -> Mesh:
+    """A 1-D ``("data",)`` mesh: pure batch parallelism, no collective
+    traffic in the decode — every backend (including the sequential
+    scan, the bit-identity oracle) shards on it."""
+    return Mesh(np.asarray(list(devices)), axis_names=("data",))
+
+
+def shard_enabled() -> bool:
+    """Both kill switches consulted: the original REPORTER_TPU_SHARD
+    and the decode knob REPORTER_TPU_DECODE_SHARD (default auto)."""
+    if os.environ.get("REPORTER_TPU_SHARD", "1").strip().lower() in (
+            "0", "off", "false"):
+        return False
+    val = os.environ.get(ENV_DECODE_SHARD, "auto").strip().lower()
+    return val not in ("0", "off", "false")
+
+
+def device_slice(devices: Sequence) -> List:
+    """This process's subset of ``devices`` per REPORTER_TPU_DEVICE_SLICE.
+
+    ``"s/p"`` — contiguous block ``s`` of ``p`` (slot-derived: prefork
+    worker ``s`` of ``p`` owns ``devices[s*n//p:(s+1)*n//p]``; with more
+    processes than devices each process falls back to the single device
+    ``s % n``, so every worker always owns at least one).
+    ``"lo:hi"`` — an explicit half-open range. Empty/absent = all.
+    A malformed spec logs and returns all devices (mis-typed slicing
+    must degrade to the safe single-mesh default, never to an empty
+    mesh)."""
+    devices = list(devices)
+    spec = os.environ.get(ENV_DEVICE_SLICE, "").strip()
+    if not spec or not devices:
+        return devices
+    m = _SLICE_RE.match(spec)
+    if not m:
+        logger.warning("%s=%r not understood (want 'slot/procs' or "
+                       "'lo:hi'); using all %d local devices",
+                       ENV_DEVICE_SLICE, spec, len(devices))
+        return devices
+    n = len(devices)
+    if m.group(1) is not None:
+        slot, procs = int(m.group(1)), int(m.group(2))
+        if procs <= 0 or slot >= procs:
+            logger.warning("%s=%r out of range; using all devices",
+                           ENV_DEVICE_SLICE, spec)
+            return devices
+        lo, hi = slot * n // procs, (slot + 1) * n // procs
+        if lo >= hi:
+            # more processes than devices: empty block -> the same
+            # proportional index the block math uses, so slots spread
+            # evenly (slot % n would pile the empty-block slots onto
+            # the low devices: n=2, procs=4 put 3 workers on device 0)
+            return [devices[slot * n // procs]]
+        return devices[lo:hi]
+    lo = int(m.group(3)) if m.group(3) else 0
+    hi = int(m.group(4)) if m.group(4) is not None else n
+    picked = devices[lo:hi]
+    if not picked:
+        logger.warning("%s=%r selects no device; using all",
+                       ENV_DEVICE_SLICE, spec)
+        return devices
+    return picked
+
+
+def _build_decode_mesh() -> Optional[Mesh]:
+    if not shard_enabled():
+        return None
+    # local devices only: in a multi-host job the decode inputs are
+    # host-local numpy arrays, and a device_put onto a global mesh's
+    # non-addressable devices would throw — each process shards over
+    # its own chips; cross-host scale-out stays uuid-partitioned
+    # (parallel/multihost.py), exactly the reference's partition axis
+    devices = device_slice(jax.local_devices())
+    n = len(devices)
+    if n <= 1:
+        return None
+    from ..utils.runtime import _env_int
+    seq = max(1, _env_int("REPORTER_TPU_SEQ_SHARDS", 1))
+    seq = min(seq, n)
+    while n % seq:  # largest feasible seq <= requested
+        seq -= 1
+    if seq > 1:
+        return make_mesh((n // seq, seq), devices=devices)
+    return make_data_mesh(devices)
+
+
+def decode_mesh() -> Optional[Mesh]:
+    """The process-global decode mesh: a 1-D ``("data",)`` mesh over
+    this process's device slice (2-D ``(data, seq)`` when
+    REPORTER_TPU_SEQ_SHARDS > 1), or None when sharding is off or only
+    one device is visible. Built once; :func:`reset_decode_mesh` drops
+    it (tests, post-fork)."""
+    global _decode_mesh
+    if _decode_mesh is _UNSET:
+        with _mesh_lock:
+            if _decode_mesh is _UNSET:
+                _decode_mesh = _build_decode_mesh()
+                if _decode_mesh is not None:
+                    logger.info(
+                        "decode mesh: %s over %d local device(s)",
+                        dict(zip(_decode_mesh.axis_names,
+                                 _decode_mesh.devices.shape)),
+                        _decode_mesh.devices.size)
+    return _decode_mesh
+
+
+def mesh_axes(mesh: Optional[Mesh]) -> Tuple[int, int]:
+    """(data, seq) axis sizes of a decode mesh (1, 1) when unsharded."""
+    if mesh is None:
+        return 1, 1
+    shape = dict(zip(mesh.axis_names, mesh.devices.shape))
+    return shape.get("data", 1), shape.get("seq", 1)
+
+
+def decode_mesh_size() -> int:
+    """The data-axis width of the process decode mesh (1 = unsharded) —
+    what chunk sizing and the dispatcher's in-flight depth scale by."""
+    return mesh_axes(decode_mesh())[0]
+
+
+def reset_decode_mesh() -> None:
+    """Forget the cached decode mesh (tests re-read the env; forked
+    workers re-derive their slice)."""
+    global _decode_mesh
+    with _mesh_lock:
+        _decode_mesh = _UNSET

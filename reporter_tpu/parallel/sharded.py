@@ -1,10 +1,19 @@
-"""Sharded batched decode: pjit over the (data, seq) mesh.
+"""Sharded batched decode: pjit over the decode mesh.
 
-Batch tensors are placed with NamedShardings — batch on ``data``, time on
-``seq`` — and the associative-scan Viterbi runs under jit; XLA's GSPMD
-partitioner inserts the collectives (the max-plus scan's cross-shard
-combines ride ICI). This is the multi-chip entry point the service and
-batch pipeline use when more than one device is visible.
+Two mesh shapes, two contracts:
+
+- the 1-D ``("data",)`` mesh (the serving default, parallel/mesh.py
+  ``decode_mesh``): pure batch parallelism — every tensor shards along
+  its leading batch axis, params replicate, and NO collective runs in
+  the decode, so every backend shards, including the sequential scan.
+  Each device runs the identical per-row program it would run alone,
+  which is why the sharded scan decode is *bit-identical* to the
+  single-device scan decode (the contract tests/test_sharded_decode.py
+  pins at 1/2/8 forced host devices).
+- the 2-D ``(data, seq)`` mesh (REPORTER_TPU_SEQ_SHARDS > 1): time
+  additionally shards along ``seq`` and XLA's GSPMD partitioner inserts
+  the max-plus scan's cross-shard combines over ICI — associative
+  backend only.
 """
 from __future__ import annotations
 
@@ -52,6 +61,36 @@ def shard_batch(mesh: Mesh, dist_m, valid, route_m, gc_m, case):
         put(gc_m, P("data", "seq")),
         put(case, P("data", "seq")),
     )
+
+
+def shard_batch_data(mesh: Mesh, dist_m, valid, route_m, gc_m, case):
+    """Device-put one padded chunk onto a 1-D ``("data",)`` mesh: every
+    tensor shards along its leading batch axis (which must divide the
+    mesh size — callers pad rows to a multiple, counted in the
+    ``padded_cells`` wide event), emission/transition params replicate
+    inside the jitted call. No time-axis padding is needed: route's
+    ragged T-1 rows only matter to ``seq`` sharding."""
+    def put(x):
+        spec = P("data", *([None] * (np.ndim(x) - 1)))
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    return (put(dist_m), put(valid), put(route_m), put(gc_m), put(case))
+
+
+def sharded_data_viterbi(mesh: Mesh, kernel):
+    """A decode callable running ``kernel`` (an unjitted batch decode —
+    scan or assoc) data-parallel over a 1-D ``("data",)`` mesh, with
+    sharded in/out specs so the (B, T) paths stay device-sharded until
+    the drain lane's d2h gather."""
+    out_sharding = (NamedSharding(mesh, P("data")),
+                    NamedSharding(mesh, P("data")))
+    decode = jax.jit(kernel, out_shardings=out_sharding)
+
+    def run(dist_m, valid, route_m, gc_m, case, sigma, beta):
+        args = shard_batch_data(mesh, dist_m, valid, route_m, gc_m, case)
+        return decode(*args, jnp.float32(sigma), jnp.float32(beta))
+
+    return run
 
 
 def sharded_viterbi(mesh: Mesh):

@@ -76,14 +76,25 @@ def writer_id_for_slot(slot: int, base: Optional[str] = None) -> str:
 
 
 def worker_main(slot: int, make_service: Callable[[], object],
-                host: str, port: int) -> int:
+                host: str, port: int,
+                procs: Optional[int] = None) -> int:
     """One worker's whole life, run just after the fork: adopt the
-    slot's writer identity, build the service (device handle, native
-    runtime, dispatcher — all POST-fork), bind the shared port with
-    ``SO_REUSEPORT`` and serve until TERMed. Returns an exit code
-    (the caller ``os._exit``\\ s it — a worker must never fall back
-    into the parent's stack)."""
+    slot's writer identity AND device slice, build the service (device
+    handle, native runtime, dispatcher — all POST-fork), bind the
+    shared port with ``SO_REUSEPORT`` and serve until TERMed. Returns
+    an exit code (the caller ``os._exit``\\ s it — a worker must never
+    fall back into the parent's stack)."""
     os.environ["REPORTER_TPU_WRITER_ID"] = writer_id_for_slot(slot)
+    # slot-derived device ownership (the writer-identity pattern, for
+    # devices): worker s of P claims its contiguous block of
+    # jax.local_devices() via REPORTER_TPU_DEVICE_SLICE, so N processes
+    # x M devices compose — every worker's decode mesh spans ITS
+    # devices and no two workers contend on one device queue. An
+    # operator-set slice wins (heterogeneous pinning); single-proc mode
+    # claims nothing.
+    if procs and procs > 1 and not os.environ.get(
+            "REPORTER_TPU_DEVICE_SLICE"):
+        os.environ["REPORTER_TPU_DEVICE_SLICE"] = f"{slot}/{procs}"
     # the parent's supervisor handlers are not ours: TERM must close
     # the listener and exit this process, not set the parent's flag
     httpd_box: Dict[str, object] = {}
@@ -179,7 +190,8 @@ def serve_prefork(make_service: Callable[[], object], host: str,
             # child: never unwind into the supervisor's stack
             code = 1
             try:
-                code = worker_main(slot, make_service, host, port)
+                code = worker_main(slot, make_service, host, port,
+                                   procs=procs)
             except BaseException:
                 logger.exception("prefork worker p%d died in startup",
                                  slot)

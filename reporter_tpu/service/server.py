@@ -66,9 +66,14 @@ class ReporterService:
         from ..utils.runtime import _env_float, _env_int
         self.threshold_sec = threshold_sec if threshold_sec is not None else \
             _env_int("THRESHOLD_SEC", 15)
+        # MATCH_BATCH_MAX default scales with the decode mesh
+        # (matcher.match_batch_default: >=2 decode chunks per drained
+        # batch, so N devices never sit idle behind a half-chunk flush)
+        from ..matcher.matcher import match_batch_default
         self.dispatcher = BatchDispatcher(
             matcher.match_many,
-            max_batch=max_batch or _env_int("MATCH_BATCH_MAX", 256),
+            max_batch=max_batch or _env_int("MATCH_BATCH_MAX", 0)
+            or match_batch_default(),
             max_wait_ms=max_wait_ms if max_wait_ms is not None else
             _env_float("MATCH_BATCH_WAIT_MS", 20.0),
             idle_grace_ms=_env_float("MATCH_BATCH_GRACE_MS", 2.0))

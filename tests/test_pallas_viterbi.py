@@ -79,9 +79,21 @@ class TestDispatch:
         monkeypatch.setenv("REPORTER_TPU_DECODE", "pallas")
         assert decode_backend(64, 8) == "pallas"
 
-    def test_default_off_tpu_is_assoc(self, monkeypatch):
+    def test_default_off_tpu_is_scan(self, monkeypatch):
+        # ISSUE 13: the CPU default is scan even on the 8-device test
+        # mesh — the 1-D ("data",) mesh shards scan rows with zero
+        # collectives, so CPU keeps the 4x-cheaper bit-identity
+        # backend; only a seq-sharded mesh needs assoc
         monkeypatch.delenv("REPORTER_TPU_DECODE", raising=False)
-        assert decode_backend(64, 8) == "assoc"  # tests run on cpu
+        assert decode_backend(64, 8) == "scan"  # tests run on cpu
+        from reporter_tpu import ops
+        monkeypatch.setenv("REPORTER_TPU_SEQ_SHARDS", "2")
+        ops.reset_sharded_cache()
+        try:
+            assert decode_backend(64, 8) == "assoc"
+        finally:
+            monkeypatch.delenv("REPORTER_TPU_SEQ_SHARDS", raising=False)
+            ops.reset_sharded_cache()
 
     def test_vmem_estimate_gates_large_buckets(self):
         assert vmem_bytes_estimate(64, 8) <= VMEM_BUDGET_BYTES

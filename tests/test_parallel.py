@@ -166,9 +166,9 @@ class TestProductionShardedPath:
     @pytest.fixture(autouse=True)
     def fresh_mesh_cache(self):
         from reporter_tpu import ops
-        ops._sharded_cache = None
+        ops.reset_sharded_cache()
         yield
-        ops._sharded_cache = None
+        ops.reset_sharded_cache()
 
     def test_batch_pad_multiple_is_data_axis(self):
         from reporter_tpu import ops

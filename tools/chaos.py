@@ -796,6 +796,22 @@ base = f"http://127.0.0.1:{port}"
 
 
 def make_service():
+    # POST-fork, per worker: apply REPORTER_TPU_PLATFORM /
+    # REPORTER_TPU_VIRTUAL_DEVICES in THIS process (the parent stays
+    # jax-free so forking is safe). Under the CI 2-proc x 2-device
+    # leg each worker then sees the forced mesh and its slot-derived
+    # REPORTER_TPU_DEVICE_SLICE claims exactly one device — a wrong
+    # slice fails the worker at startup, which fails the scenario.
+    from reporter_tpu.utils.runtime import ensure_backend
+    ensure_backend()
+    want = os.environ.get("REPORTER_TPU_VIRTUAL_DEVICES")
+    if want:
+        import jax
+        assert len(jax.devices()) == int(want), \
+            (len(jax.devices()), want)
+        from reporter_tpu.parallel import mesh as pmesh
+        owned = pmesh.device_slice(jax.local_devices())
+        assert len(owned) == max(1, int(want) // 2), owned
     return ReporterService(SegmentMatcher(net=city), threshold_sec=15,
                            max_batch=64, max_wait_ms=5.0)
 

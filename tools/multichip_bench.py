@@ -41,6 +41,15 @@ HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 _LEG_CODE = r"""
 import json, time
+# THE r06 BUG: nothing in this child ever applied the forced host-
+# device count, so every leg came up on ONE device and the "2/4/8
+# device" ratios measured chunk-size noise (devices_seen: 1 in every
+# committed r06 leg). ensure_backend honours REPORTER_TPU_PLATFORM /
+# REPORTER_TPU_VIRTUAL_DEVICES BEFORE the first backend resolution —
+# it must run before anything imports a jax-touching module.
+from reporter_tpu.utils.runtime import ensure_backend
+ensure_backend()
+import jax
 import numpy as np
 from reporter_tpu.core.tracebatch import TraceBatch
 from reporter_tpu.matcher import MatchParams, SegmentMatcher
@@ -64,9 +73,10 @@ for _ in range(3):
     t0 = time.perf_counter()
     matcher.match_many(tb)
     best = min(best, time.perf_counter() - t0)
-import jax
+from reporter_tpu.ops import decode_mesh_size
 print("LEG:" + json.dumps({{
     "devices_seen": len(jax.devices()),
+    "mesh_data": decode_mesh_size(),
     "traces_per_sec": round(n_traces / best, 1)}}))
 """
 
@@ -78,6 +88,8 @@ def run_leg(n_devices: int, n_traces: int, timeout_s: float) -> dict:
                REPORTER_TPU_VIRTUAL_DEVICES=str(n_devices),
                REPORTER_TPU_SHARD="1",
                REPORTER_TPU_PIPELINE="0")
+    # a leg measures ITS device count, not an inherited slice
+    env.pop("REPORTER_TPU_DEVICE_SLICE", None)
     try:
         proc = subprocess.run(
             [sys.executable, "-c", _LEG_CODE.format(n_traces=n_traces)],
@@ -93,8 +105,19 @@ def run_leg(n_devices: int, n_traces: int, timeout_s: float) -> dict:
             parsed = json.loads(line[len("LEG:"):])
             leg["traces_per_sec"] = parsed["traces_per_sec"]
             leg["devices_seen"] = parsed["devices_seen"]
+            leg["mesh_data"] = parsed["mesh_data"]
     if proc.returncode != 0 or leg["traces_per_sec"] is None:
         leg["tail"] = (proc.stderr.strip().splitlines() or ["?"])[-1][:200]
+    # the r06 lesson, enforced: a leg that did not actually SEE its
+    # requested device count is a failed leg, not a slow one — its
+    # throughput would silently become a bogus ratio denominator/
+    # numerator. (devices_seen is leg-asserted; perf_gate --multichip
+    # re-checks the committed artifact.)
+    if leg["rc"] == 0 and leg.get("devices_seen") != n_devices:
+        leg["rc"] = 5
+        leg["tail"] = (f"devices_seen={leg.get('devices_seen')} != "
+                       f"requested {n_devices}: the forced host-device "
+                       "count never reached the leg")
     return leg
 
 

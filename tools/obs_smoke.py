@@ -282,9 +282,14 @@ def leg_service() -> int:
             return fail(f"second same-shape request recompiled: "
                         f"decode.compile.count {c0} -> {c1}")
 
-        # mixed-length batch through one dispatcher round trip
+        # mixed-length batch through one dispatcher round trip. Lengths
+        # chosen so the 64-bucket pair (40, 45) rides ONE multi-trace
+        # chunk: both sit above the 32 rung, so the ISSUE-13 adaptive
+        # splitter (which would break a (12, 25, 40) mix into 1-trace
+        # pow2 sub-batches) has nothing to reclaim and the wide-event
+        # assertions below still see a >=2-trace chunk
         mixed = []
-        for i, n_pts in enumerate((12, 25, 40)):
+        for i, n_pts in enumerate((12, 40, 45)):
             r = _request(city, f"mix-{i}", seed=20 + i)
             r["trace"] = r["trace"][:n_pts]
             mixed.append(r)

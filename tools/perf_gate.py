@@ -177,6 +177,41 @@ def gate_shares_absolute(candidate: dict, max_shares: dict
     return (not verdict["failures"]), verdict
 
 
+def gate_padding_waste(candidate: dict, ceiling: float
+                       ) -> Tuple[bool, dict]:
+    """Hard ceiling on the adaptive-bucket leg's padding waste
+    (``--max-padding-waste 0.10``): the ISSUE-13 acceptance number —
+    the mixed-length bench batch's decoded point slots must stay
+    mostly real probes, not pad. Reads the bench artifact's
+    ``bucketing.adaptive_waste`` (the after-leg of the before/after
+    pair); a candidate without the block fails loudly — the ceiling
+    the caller believes binds must never be skipped silently. The one
+    exception is an EXPLICIT skip (``bucketing.skipped``, recorded by
+    bench.py when the native runtime is absent): a declared
+    native-less run passes with the note carried into the verdict —
+    nothing regressed, the leg just cannot run there."""
+    bucketing = candidate.get("bucketing") or {}
+    waste = bucketing.get("adaptive_waste")
+    verdict: dict = {"candidate": {"source": candidate.get("source"),
+                                   "bucketing": bucketing or None},
+                     "max_padding_waste": ceiling, "failures": []}
+    if bucketing.get("skipped"):
+        verdict["note"] = f"bucketing leg skipped: {bucketing['skipped']}"
+        return True, verdict
+    if waste is None:
+        verdict["failures"].append(
+            {"check": "padding_waste", "reason": "candidate records no "
+             "bucketing.adaptive_waste to hold under the ceiling"})
+    elif waste > ceiling:
+        verdict["failures"].append(
+            {"check": "padding_waste", "candidate": waste,
+             "ceiling": ceiling,
+             "reason": f"adaptive-bucket padding waste {waste} exceeds "
+             f"the hard ceiling {ceiling} (fixed-ladder leg recorded "
+             f"{bucketing.get('fixed_waste')})"})
+    return (not verdict["failures"]), verdict
+
+
 def gate_multichip(path: str, min_ratio: float) -> Tuple[bool, dict]:
     """Gate a tools/multichip_bench.py artifact: every leg ran, ratios
     were measured, and no device count fell below ``min_ratio`` x the
@@ -201,6 +236,19 @@ def gate_multichip(path: str, min_ratio: float) -> Tuple[bool, dict]:
             {"check": "multichip", "reason": "artifact carries no "
              "device-count ratios (legacy liveness-only verdict? "
              "re-run tools/multichip_bench.py)"})
+    # the r06 lesson: every leg must have SEEN the device count it
+    # claims to measure — an artifact whose legs disagree with their
+    # requested counts carries ratios of nothing (the committed r06
+    # ratios 0.71-0.89 were exactly this, devices_seen: 1 everywhere)
+    for leg in art.get("legs") or []:
+        if leg.get("devices_seen") != leg.get("n_devices"):
+            verdict["failures"].append(
+                {"check": "multichip", "n_devices": leg.get("n_devices"),
+                 "devices_seen": leg.get("devices_seen"),
+                 "reason": f"leg requested {leg.get('n_devices')} "
+                 f"device(s) but saw {leg.get('devices_seen')} — the "
+                 "forced host-device count never reached the leg, so "
+                 "its throughput ratio is meaningless"})
     for count, ratio in sorted(ratios.items(), key=lambda kv: int(kv[0])):
         if ratio < min_ratio:
             verdict["failures"].append(
@@ -274,6 +322,12 @@ def main(argv=None) -> int:
                         help="hard absolute ceiling on a candidate "
                         "stage share (repeatable), e.g. report=0.2 — "
                         "checked in addition to the median gate")
+    parser.add_argument("--max-padding-waste", type=float, default=None,
+                        metavar="CEIL",
+                        help="hard ceiling on the candidate's adaptive-"
+                        "bucket padding waste (bucketing.adaptive_waste"
+                        " from bench.py's before/after pair), e.g. 0.10"
+                        " — checked in addition to the median gate")
     parser.add_argument("--min-fault-ratio", type=float, default=0.4,
                         help="floor for the bigreplay chaos-over-clean "
                         "throughput ratio (default 0.4 — small smoke "
@@ -298,11 +352,13 @@ def main(argv=None) -> int:
             max_shares[stage.strip()] = float(ceil)
         except ValueError:
             parser.error(f"--max-share wants STAGE=CEIL, got {spec!r}")
-    if max_shares and (args.bigreplay or args.multichip):
-        # those artifacts carry no stage shares — refuse loudly rather
-        # than silently ignoring a ceiling the caller believes binds
-        parser.error("--max-share applies to --candidate/--self-check "
-                     "runs only")
+    if (max_shares or args.max_padding_waste is not None) \
+            and (args.bigreplay or args.multichip):
+        # those artifacts carry no stage shares / bucketing block —
+        # refuse loudly rather than silently ignoring a ceiling the
+        # caller believes binds
+        parser.error("--max-share/--max-padding-waste apply to "
+                     "--candidate/--self-check runs only")
 
     if args.bigreplay:
         passed, verdict = gate_bigreplay(args.bigreplay,
@@ -357,6 +413,13 @@ def main(argv=None) -> int:
         verdict["max_shares"] = abs_verdict["max_shares"]
         verdict["failures"].extend(abs_verdict["failures"])
         passed = passed and abs_ok
+
+    if args.max_padding_waste is not None:
+        pw_ok, pw_verdict = gate_padding_waste(candidate,
+                                               args.max_padding_waste)
+        verdict["max_padding_waste"] = args.max_padding_waste
+        verdict["failures"].extend(pw_verdict["failures"])
+        passed = passed and pw_ok
 
     verdict["pass"] = passed
     print(json.dumps(verdict, separators=(",", ":")))
