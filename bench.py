@@ -364,6 +364,75 @@ def _bucketing_leg(city, matcher, reqs_pool):
     }
 
 
+def _query_leg(n_segments: int = 256, repeats: int = 3):
+    """The serving-tier batched-query pair (ISSUE 14): ONE
+    ``query_many(256)`` sweep vs 256 single ``query_segment`` calls
+    over the same synthetic store — 8 partitions x 4 live deltas, every
+    segment with histogram cells and transitions (the pre-compaction
+    steady state a dashboard hits). Answers are asserted EQUAL before
+    timing (the speedup must never be a different answer), and the
+    best-of-N ratio is gated by ``perf_gate --min-query-ratio``."""
+    import shutil
+    import tempfile
+
+    from reporter_tpu.core.osmlr import make_segment_id
+    from reporter_tpu.datastore import (
+        LocalDatastore,
+        ObservationBatch,
+        query_many,
+        query_segment,
+    )
+
+    tmp = tempfile.mkdtemp(prefix="bench_query_")
+    try:
+        ds = LocalDatastore(tmp)
+        rng = np.random.default_rng(7)
+        tiles = [1000 + i for i in range(8)]
+        seg_ids = [make_segment_id(2, tiles[i % 8], i)
+                   for i in range(n_segments)]
+        seg_arr = np.array(seg_ids, dtype=np.int64)
+        for d in range(4):
+            n_obs = n_segments * 8
+            dur = rng.uniform(5, 30, n_obs)
+            obs = ObservationBatch(
+                segment_id=rng.choice(seg_arr, size=n_obs),
+                next_id=rng.choice(seg_arr, size=n_obs),
+                duration_s=dur,
+                count=np.ones(n_obs, dtype=np.int64),
+                length_m=(dur * rng.uniform(3, 20, n_obs))
+                .astype(np.int64) + 1,
+                queue_m=np.zeros(n_obs, dtype=np.int64),
+                min_ts=rng.integers(1500000000, 1500600000, n_obs),
+                max_ts=rng.integers(1500600000, 1500700000, n_obs))
+            ds.ingest(obs, ingest_key=f"bench-{d}")
+
+        many = query_many(ds, seg_ids)  # warm handles + assert parity
+        singles = [query_segment(ds, s) for s in seg_ids]
+        if many != singles:
+            raise RuntimeError("query_many answers differ from single "
+                               "queries — parity broken, ratio void")
+        best_single = best_many = float("inf")
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            for s in seg_ids:
+                query_segment(ds, s)
+            best_single = min(best_single, time.perf_counter() - t0)
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            query_many(ds, seg_ids)
+            best_many = min(best_many, time.perf_counter() - t0)
+        return {
+            "n_segments": n_segments,
+            "partitions": 8,
+            "live_deltas_per_partition": 4,
+            "single_s": round(best_single, 6),
+            "many_s": round(best_many, 6),
+            "batch_ratio": round(best_single / best_many, 2),
+        }
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def main():
     n_traces = int(os.environ.get("BENCH_TRACES", 512))
     n_base = int(os.environ.get("BENCH_BASELINE_TRACES", 128))
@@ -522,6 +591,14 @@ def main():
     except Exception as e:  # record the failure, keep the artifact
         bucketing_field = {"error": str(e)[:200]}
 
+    # -- serving-tier batched-query pair (ISSUE 14) -----------------------
+    # query_many(256) vs 256 singles over one synthetic store; parity
+    # asserted inside the leg, ratio gated by perf_gate
+    try:
+        query_field = _query_leg()
+    except Exception as e:  # record the failure, keep the artifact
+        query_field = {"error": str(e)[:200]}
+
     # -- optional second decode backend: the fused pallas kernel ----------
     # recorded in the same artifact so hardware claims in docstrings trace
     # to a committed number; default-on only where it runs compiled (tpu)
@@ -563,6 +640,7 @@ def main():
                      "n_traces": n_base, "repeats": base_repeats},
         "compile": compile_field,
         "bucketing": bucketing_field,
+        "query": query_field,
         "probe": dict(rt.probe_info,
                       **({"pipelined_probe": probe_pipelined}
                          if probe_pipelined else {})),

@@ -54,6 +54,9 @@ ENV_KNOBS: Dict[str, str] = {
     "REPORTER_TPU_PROCESS_ID": "jax.distributed process id",
     "REPORTER_TPU_DATASTORE": "histogram-store dir served on /histogram",
     "REPORTER_TPU_DATASTORE_HANDLES": "partition mmap-handle LRU size",
+    "REPORTER_TPU_STORE_LEASE_S": "cross-process writer-lease TTL (0 off)",
+    "REPORTER_TPU_COMPACT_INTERVAL_S": "background compactor pace (s)",
+    "REPORTER_TPU_CITY_BUDGET_MB": "multi-city residency LRU byte budget",
     "REPORTER_TPU_NATIVE_LIB": "prebuilt .so override (sanitizers/CI)",
     "REPORTER_TPU_FAULTS": "deterministic failpoint spec",
     "REPORTER_TPU_CIRCUIT_THRESHOLD": "errors that open the breaker",
@@ -151,8 +154,17 @@ METRICS: Dict[str, str] = {
     "datastore.store.append": "segment commit (timer)",
     "datastore.store.compact": "compaction pass (timer)",
     "datastore.store.auto_compactions": "pressure-policy compactions",
+    "datastore.store.stale_commits": "seq-fence aborts (lease lapsed)",
     "datastore.query.cache.hits": "partition-handle LRU hits",
     "datastore.query.cache.misses": "partition-handle LRU misses",
+    "datastore.query.many": "batched multi-segment query sweep (timer)",
+    "datastore.query.bbox": "bbox query: resolve + batched sweep (timer)",
+    "datastore.query.batched_segments": "segments served via query_many",
+    "datastore.lease.*": "writer-lease acquires/renewals/steals/rejections",
+    "datastore.compactor.*": "background compaction passes/compactions",
+    "datastore.city.*": "city-residency LRU loads/hits/evictions",
+    "datastore.profile.exports": "route-memo profile artifacts written",
+    "datastore.profile.warmed_pairs": "memo pairs pre-warmed at city load",
     # observability
     "flightrec.dumps": "flight-recorder postmortems written",
     # device-level profiler (obs/profiler.py)
@@ -188,6 +200,9 @@ FAULT_SITES: Dict[str, str] = {
     "matcher.submit": "report submit failure -> bounded requeue",
     "egress.http": "tile sink failure -> dead-letter spool",
     "datastore.commit": "segment commit failure -> caller quarantine",
+    "datastore.compact": "crash mid-compaction -> orphan dir, manifest "
+                         "untorn; next holder re-compacts",
+    "datastore.lease": "lease I/O failure -> mutation refused (spooled)",
     "state.save": "snapshot failure -> degraded (wider replay window)",
     "worker.offer": "crash at an exact stream position",
     "worker.post_egress": "crash between sink ack and epoch marker",
@@ -202,6 +217,11 @@ FAULT_SITES: Dict[str, str] = {
 DURABLE_MODULES: Tuple[str, ...] = (
     "reporter_tpu/datastore/store.py",
     "reporter_tpu/datastore/ingest.py",
+    # the per-city route-memo profile commits into the store root (a
+    # torn profile would warm garbage); the .lease file is deliberately
+    # NOT here — it is flock-serialised coordination state whose torn
+    # body safely parses as "no holder" (datastore/lease.py docstring)
+    "reporter_tpu/datastore/profile.py",
     "reporter_tpu/streaming/state.py",
     "reporter_tpu/streaming/anonymiser.py",
     "reporter_tpu/utils/fsio.py",

@@ -10,7 +10,12 @@ into per-segment speed histograms and answering queries:
 - :mod:`aggregate`  — whole-batch searchsorted/add.at histogram kernel
 - :mod:`store`      — append-only columnar partitions, atomic commits,
   mmap reads, compaction
-- :mod:`query`      — mean / percentiles / coverage / transitions
+- :mod:`query`      — mean / percentiles / coverage / transitions,
+  batched multi-segment + bbox sweeps
+- :mod:`lease`      — the cross-process writer lease every mutating
+  entry point holds
+- :mod:`compactor`  — background delta-pressure compaction (lease-owned)
+- :mod:`profile`    — per-city route-memo pre-warm artifact
 
 :class:`LocalDatastore` is the one-stop facade the service's
 ``/histogram`` action, ``datastore_cli``, and the streaming worker's tee
@@ -21,11 +26,16 @@ from __future__ import annotations
 from typing import Optional, Sequence
 
 from .aggregate import Delta, aggregate, merge_deltas
+from .compactor import BackgroundCompactor
 from .ingest import ingest_dir, ingest_file, parse_tile_csv, scan_tiles
+from .lease import LeaseHeldElsewhere, StoreLease
+from .profile import export_profile, load_profile, profile_path, warm_matcher
 from .query import (
     DEFAULT_PERCENTILES,
     hours_for_range,
     parse_hours_spec,
+    query_bbox,
+    query_many,
     query_segment,
 )
 from .schema import ObservationBatch
@@ -66,10 +76,36 @@ class LocalDatastore(HistogramStore):
                              percentiles=percentiles,
                              max_transitions=max_transitions)
 
+    def query_many(self, segment_ids,
+                   hours: Optional[Sequence[int]] = None,
+                   percentiles: Sequence[float] = DEFAULT_PERCENTILES,
+                   max_transitions: int = 32) -> list:
+        """Batched spelling of :meth:`query`: one sweep per partition's
+        live segment files serves the whole id list (datastore/query.py)
+        — answer-identical to N single queries by construction."""
+        return query_many(self, segment_ids, hours=hours,
+                          percentiles=percentiles,
+                          max_transitions=max_transitions)
+
+    def query_bbox(self, bbox, level: int,
+                   hours: Optional[Sequence[int]] = None,
+                   percentiles: Sequence[float] = DEFAULT_PERCENTILES,
+                   max_transitions: int = 32,
+                   max_segments: Optional[int] = None) -> dict:
+        kwargs = {}
+        if max_segments is not None:
+            kwargs["max_segments"] = max_segments
+        return query_bbox(self, bbox, level, hours=hours,
+                          percentiles=percentiles,
+                          max_transitions=max_transitions, **kwargs)
+
 
 __all__ = [
-    "Delta", "HistogramStore", "LocalDatastore", "ObservationBatch",
-    "aggregate", "merge_deltas", "parse_tile_csv", "scan_tiles",
-    "ingest_file", "ingest_dir", "query_segment", "hours_for_range",
-    "parse_hours_spec", "DEFAULT_PERCENTILES",
+    "BackgroundCompactor", "Delta", "HistogramStore",
+    "LeaseHeldElsewhere", "LocalDatastore", "ObservationBatch",
+    "StoreLease", "aggregate", "merge_deltas", "parse_tile_csv",
+    "scan_tiles", "ingest_file", "ingest_dir", "query_segment",
+    "query_many", "query_bbox", "hours_for_range", "parse_hours_spec",
+    "export_profile", "load_profile", "warm_matcher", "profile_path",
+    "DEFAULT_PERCENTILES",
 ]

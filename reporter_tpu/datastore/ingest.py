@@ -24,6 +24,7 @@ from typing import Iterator, Optional
 import numpy as np
 
 from ..utils import metrics
+from .lease import LeaseHeldElsewhere
 from .schema import INVALID_SEGMENT_ID, ObservationBatch
 
 logger = logging.getLogger("reporter_tpu.datastore")
@@ -75,7 +76,10 @@ def scan_tiles(root: str,
     recorder's postmortem dumps (``.flightrec`` — span JSON), the
     replayer's poison quarantine (``.quarantine`` — entries that beat
     the replay budget, manual autopsy only) and dot-state files when
-    scanning a results root."""
+    scanning a results root. The dot-file skip also covers the store's
+    own control artifacts when a store root is (mis)scanned: the
+    ``.lease`` writer-lease file and the ``.profile`` route-memo
+    pre-warm artifact are coordination state, never tile CSV."""
     for dirpath, dirnames, filenames in os.walk(root):
         dirnames[:] = sorted(d for d in dirnames if d not in skip_names)
         for name in sorted(filenames):
@@ -114,9 +118,16 @@ def ingest_dir(store, root: str, delete: bool = False,
     some partitions' deltas before the error, so blindly replaying it
     would double-count those (the ledger shields exactly the partitions
     that committed). Quarantined files keep the unappended rows for
-    manual recovery. Returns ``{"files", "rows", "failures"}``.
+    manual recovery. Returns ``{"files", "rows", "failures"}`` (plus
+    ``"aborted": true`` when a mid-replay writer-lease loss stopped
+    the pass — files intact and replayable, NOT counted as failures).
     """
     files = rows = failures = 0
+    aborted = False
+    # writer-lease gate up front: a replay against a store another
+    # process owns must refuse loudly BEFORE touching any tile — not
+    # quarantine every file as "failed"
+    store.lease.require()
     with metrics.timer("datastore.ingest.dir"):
         for path in scan_tiles(root):
             if limit is not None and files >= limit:
@@ -124,6 +135,17 @@ def ingest_dir(store, root: str, delete: bool = False,
             key = os.path.relpath(path, root).replace(os.sep, "/")
             try:
                 rows += ingest_file(store, path, ingest_key=key)
+            except LeaseHeldElsewhere:
+                # stolen mid-replay (our lease expired under load): the
+                # file is intact and replayable — abort the pass, do
+                # NOT quarantine, and do NOT count it as a failure
+                # ("failures" means quarantined files; this is a
+                # healthy retryable abort, flagged separately)
+                logger.warning("writer lease lost mid-replay of %s; "
+                               "aborting (files left for the next run)",
+                               root)
+                aborted = True
+                break
             except Exception as e:
                 logger.error("could not ingest %s (quarantining): %s",
                              path, e)
@@ -139,7 +161,12 @@ def ingest_dir(store, root: str, delete: bool = False,
             if delete:
                 os.unlink(path)
     metrics.count("datastore.ingest.files", files)
-    return {"files": files, "rows": rows, "failures": failures}
+    out = {"files": files, "rows": rows, "failures": failures}
+    if aborted:
+        # a mid-replay lease loss: nothing quarantined, everything
+        # left replayable — distinct from "failures" (quarantined)
+        out["aborted"] = True
+    return out
 
 
 __all__ = ["parse_tile_csv", "scan_tiles", "ingest_file", "ingest_dir"]

@@ -44,6 +44,7 @@ import numpy as np
 from ..utils import faults, fsio, metrics
 from ..utils import locks as _locks
 from .aggregate import Delta, aggregate, merge_deltas
+from .lease import StoreLease
 from .schema import ObservationBatch
 
 logger = logging.getLogger("reporter_tpu.datastore")
@@ -69,6 +70,18 @@ _COLUMNS = (
     ("trans_to", np.int64),
     ("trans_count", np.int64),
 )
+
+
+def pressure_exceeded(n_deltas: int, delta_bytes: int,
+                      max_deltas: Optional[int],
+                      max_delta_bytes: Optional[int]) -> bool:
+    """THE compaction-pressure predicate — one definition shared by
+    the store's automatic policy and the background compactor's
+    backlog gauge, so the gauge can never report pressure the policy
+    would not compact (or vice versa)."""
+    return ((max_deltas is not None and n_deltas > max_deltas)
+            or (max_delta_bytes is not None
+                and delta_bytes > max_delta_bytes))
 
 
 class HistogramStore:
@@ -105,6 +118,18 @@ class HistogramStore:
         self._handle_lock = _locks.new_lock("datastore.handles")
         # (pdir, (segment names...)) -> [Delta] of live mmap handles
         self._handles: "OrderedDict[tuple, List[Delta]]" = OrderedDict()
+        # (pdir, (segment names...)) -> int64 resident segment ids —
+        # the bbox query's enumeration, cached under the same
+        # manifest-content key (and bound) as the handles: recomputing
+        # it per request would rescan every live file's whole key
+        # column at dashboard QPS
+        self._resident_ids: "OrderedDict[tuple, np.ndarray]" = \
+            OrderedDict()
+        # cross-process writer lease (lease.py): every mutating entry
+        # point below must hold it — prefork slots, the drainer and the
+        # worker tee can all point at this root at once, and the
+        # in-process _lock above cannot see the other processes
+        self.lease = StoreLease(root)
 
     # -- paths -------------------------------------------------------------
     def partition_dir(self, level: int, index: int) -> str:
@@ -170,6 +195,11 @@ class HistogramStore:
         # the tile) and the crash-safe protocol below leaves only an
         # ignorable temp dir behind
         faults.failpoint("datastore.commit")
+        # cross-process gate FIRST: a non-holder must refuse before any
+        # staging I/O — the tee catches LeaseHeldElsewhere and spools
+        # the tile body for replay, it never risks a manifest commit
+        # interleaved with the live holder's
+        self.lease.require()
         with metrics.timer("datastore.store.append"):
             pdir = self.partition_dir(level, index)
             os.makedirs(pdir, exist_ok=True)
@@ -205,6 +235,7 @@ class HistogramStore:
                 seq = manifest["seq"] + 1
                 name = f"delta-{seq:06d}"
                 self._commit_segment(pdir, tmp, name)
+                self._check_seq_fence(pdir, seq - 1)
                 manifest["seq"] = seq
                 manifest["segments"] = manifest["segments"] + [name]
                 if ingest_key is not None:
@@ -253,9 +284,69 @@ class HistogramStore:
         partition dir — a power loss right after the manifest lists
         this segment cannot surface empty columns. The content fsyncs
         live in _stage_segment (DUR002 is function-granular by design;
-        the split exists so the fsync-heavy staging runs unlocked)."""
-        os.replace(tmp, os.path.join(pdir, name))  # lint: ignore[DUR002]
+        the split exists so the fsync-heavy staging runs unlocked).
+
+        A pre-existing dir at the target name is a crashed commit's
+        orphan, never live data, PROVIDED we verifiably hold the lease
+        at this instant: committed names are seq-monotonic (every
+        commit uses manifest seq + 1 under the lease + lock), so a
+        manifest can only list names at or below its seq — the name
+        being committed now is above it. A holder SIGKILLed between
+        rename and manifest write (chaos lease_kill) leaves exactly
+        this orphan, and the next holder's commit at the same seq must
+        replace it, not ENOTEMPTY. The proviso is re-checked HERE, at
+        the last moment before the destructive steps: a holder that
+        stalled past its TTL inside the staged merge (GC/NFS/swap) may
+        have been stolen from — its deadline lapsed, so require() hits
+        the slow path, sees the live new holder, and fails LOUDLY
+        (LeaseHeldElsewhere) instead of clearing that holder's
+        committed segment and overwriting its manifest from a stale
+        read."""
+        self.lease.require()
+        dest = os.path.join(pdir, name)
+        if os.path.exists(dest):
+            if not self.lease.enabled():
+                # lease off = the proviso cannot be verified: an
+                # existing dest may be ANOTHER process's live commit,
+                # so keep the loud ENOTEMPTY below over any clearing
+                logger.error("commit target %s already exists and the "
+                             "writer lease is disabled — cannot prove "
+                             "it is an orphan", dest)
+            else:
+                # NON-DESTRUCTIVE clearing: rename the orphan aside
+                # (dot-prefixed, manifest-invisible) instead of rmtree.
+                # Even in the worst post-require stall — our lease
+                # lapses RIGHT HERE and the dest is actually the new
+                # holder's live commit — its bytes survive for
+                # recovery, and the seq fence at manifest-write time
+                # (append/_compact_partition) aborts our stale commit
+                # before it can tear the manifest.
+                aside = os.path.join(
+                    pdir, f".orphan-{os.getpid()}-{next(_STAGE_IDS)}")
+                logger.warning("moving crashed-commit orphan %s aside "
+                               "to %s", dest, os.path.basename(aside))
+                os.replace(dest, aside)
+        os.replace(tmp, dest)  # lint: ignore[DUR002]
         fsio.fsync_dir(pdir)
+
+    def _check_seq_fence(self, pdir: str, expected_seq: int) -> None:
+        """Optimistic fence re-read RIGHT BEFORE a manifest write: the
+        manifest's seq must still be what this commit was computed
+        from. Within one process the store lock guarantees it; across
+        processes only a holder that stalled past its TTL and was
+        stolen from can trip it — that stale holder must abort LOUDLY
+        (its renamed segment stays behind as an ignorable orphan)
+        rather than overwrite the new live holder's manifest from a
+        stale read."""
+        current = self._read_manifest(pdir)["seq"]
+        if current != expected_seq:
+            metrics.count("datastore.store.stale_commits")
+            raise RuntimeError(
+                f"stale commit on {pdir}: manifest seq moved "
+                f"{expected_seq} -> {current} underneath this writer "
+                "(lease lapsed mid-commit?); aborting before the "
+                "manifest tears — the staged segment is left as an "
+                "orphan")
 
     def ingest(self, obs: ObservationBatch,
                max_deltas: Optional[int] = None,
@@ -329,6 +420,36 @@ class HistogramStore:
                     self._handles.popitem(last=False)
         return out
 
+    def resident_segments(self, level: int, index: int) -> np.ndarray:
+        """Distinct segment ids with histogram cells in one partition,
+        cached keyed by the manifest's segment list (append/compaction
+        re-key it, exactly like the handle LRU — the manifest read IS
+        the invalidation signal)."""
+        pdir = self.partition_dir(level, index)
+        manifest = self._read_manifest(pdir)
+        key = (pdir, tuple(manifest["segments"]))
+        if self.handle_cache_size:
+            with self._handle_lock:
+                got = self._resident_ids.get(key)
+                if got is not None:
+                    self._resident_ids.move_to_end(key)
+                    return got
+        from .schema import CELLS_PER_SEGMENT
+        segs = [np.unique(np.asarray(part.hist_key) // CELLS_PER_SEGMENT)
+                for part in self.live_segments(level, index)]
+        ids = np.unique(np.concatenate(segs)) if segs \
+            else np.zeros(0, dtype=np.int64)
+        if self.handle_cache_size:
+            with self._handle_lock:
+                for stale in [k for k in self._resident_ids
+                              if k[0] == pdir and k != key]:
+                    del self._resident_ids[stale]
+                self._resident_ids[key] = ids
+                self._resident_ids.move_to_end(key)
+                while len(self._resident_ids) > self.handle_cache_size:
+                    self._resident_ids.popitem(last=False)
+        return ids
+
     # -- compaction --------------------------------------------------------
     def _delta_pressure(self, pdir: str, names: List[str]) -> Tuple[int, int]:
         """(count, bytes) of uncompacted ``delta-`` segments — the inputs
@@ -360,6 +481,9 @@ class HistogramStore:
         operation needs no manual compaction pass). Returns
         ``{"partitions", "merged_segments", "skipped"}``."""
         merged = parts = skipped = 0
+        # fail fast before the partition walk; _compact_partition
+        # re-checks per partition (the lease can be stolen mid-sweep)
+        self.lease.require()
         thresholds = max_deltas is not None or max_delta_bytes is not None
         with metrics.timer("datastore.store.compact"):
             for lvl, idx in list(self.partitions()):
@@ -390,13 +514,16 @@ class HistogramStore:
         pdir = self.partition_dir(level, index)
         names = self._read_manifest(pdir)["segments"]
         n, nbytes = self._delta_pressure(pdir, names)
-        if not ((max_deltas is not None and n > max_deltas) or
-                (max_delta_bytes is not None and nbytes > max_delta_bytes)):
+        if not pressure_exceeded(n, nbytes, max_deltas, max_delta_bytes):
             return None
         metrics.count("datastore.store.auto_compactions")
         return self._compact_partition(level, index)
 
     def _compact_partition(self, level: int, index: int) -> int:
+        # same cross-process gate as append: the torn-manifest scenario
+        # the lease exists for IS two compactions interleaving their
+        # seq bumps (tests/test_serving_tier.py pins it)
+        self.lease.require()
         with self._lock:
             pdir = self.partition_dir(level, index)
             manifest = self._read_manifest(pdir)
@@ -411,6 +538,14 @@ class HistogramStore:
             # the live segment list, which must not move underneath it
             tmp = self._stage_segment(pdir, merge_deltas(deltas))
             self._commit_segment(pdir, tmp, base)
+            # chaos hook (lease_kill): a crash HERE dies HOLDING the
+            # lease mid-compaction, in the widest window — the merged
+            # base- dir is renamed in place but the manifest still
+            # lists the old segments. Readers stay manifest-driven (the
+            # orphan dir is invisible), and the next process must steal
+            # the dead holder's lease and re-compact to an untorn state
+            faults.failpoint("datastore.compact")
+            self._check_seq_fence(pdir, seq - 1)
             # the ingested ledger survives compaction: the merged base
             # still CONTAINS those flushes, so dropping their keys would
             # re-open the double-ingest window the ledger closes
@@ -421,11 +556,41 @@ class HistogramStore:
             # the new manifest is durable; merged segment dirs are dead
             for name in names:
                 shutil.rmtree(os.path.join(pdir, name), ignore_errors=True)
+            # garbage-collect aside-renamed orphans while we verifiably
+            # hold the lease: they are manifest-invisible, so this is
+            # pure disk hygiene. (.tmp- stage dirs are NOT touched — a
+            # concurrent append in THIS process stages unlocked, so an
+            # in-flight .tmp- dir may be live)
+            for leftover in os.listdir(pdir):
+                if leftover.startswith(".orphan-"):
+                    shutil.rmtree(os.path.join(pdir, leftover),
+                                  ignore_errors=True)
             logger.info("compacted %d/%d: %d segments -> %s",
                         level, index, len(names), base)
             return len(names)
 
     # -- introspection -----------------------------------------------------
+    def merged_cells(self) -> Dict[tuple, tuple]:
+        """``{(level, index, hist_key): (count, speed_sum)}`` merged
+        across every committed segment (speed sums rounded to 1e-6) —
+        the layout-independent parity comparand the chaos/bigreplay
+        exactly-once proofs assert with: two stores that compacted at
+        different points differ byte-wise but must carry identical
+        cells. ONE definition, so the harnesses cannot drift apart."""
+        out: Dict[tuple, tuple] = {}
+        for level, index in self.partitions():
+            parts = self.live_segments(level, index)
+            if not parts:
+                continue
+            merged = merge_deltas(parts)
+            keys = np.asarray(merged.hist_key)
+            counts = np.asarray(merged.hist_count)
+            sums = np.asarray(merged.hist_speed_sum)
+            for k, c, s in zip(keys.tolist(), counts.tolist(),
+                               sums.tolist()):
+                out[(level, index, k)] = (c, round(s, 6))
+        return out
+
     def stats(self) -> dict:
         """Partition/segment/cell totals plus on-disk byte size."""
         out: Dict[str, int] = {"partitions": 0, "segments": 0, "cells": 0,

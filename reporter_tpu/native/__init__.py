@@ -31,7 +31,7 @@ _LIB_PATH = os.path.join(_DIR, "libreporter_host.so")
 # Must equal host_runtime.cpp's rt_abi_version(). The handshake in
 # _get_lib() turns a half-landed ABI change (library and binding updated
 # in different commits) into a loud numpy fallback instead of a segfault.
-ABI_VERSION = 12
+ABI_VERSION = 13
 _lib = None
 # long_hold_ok: the once-only init hold (subprocess make + ABI
 # handshake, bounded by the 180 s build timeout) is the design — both
@@ -131,6 +131,17 @@ def _init_locked() -> Optional[ctypes.CDLL]:
         lib.rt_cache_size.restype = ctypes.c_int64
         c_i64arr = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
         lib.rt_route_memo_stats.argtypes = [ctypes.c_void_p, c_i64arr]
+        # profile export / pre-warm of the route-pair memo (ABI 13):
+        # export dumps resident (edge_from, edge_to) pairs, warm
+        # recomputes and inserts their node kernels bit-identically to
+        # the serving path's miss (datastore/profile.py)
+        lib.rt_route_memo_export.restype = ctypes.c_int64
+        lib.rt_route_memo_export.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, c_i32p, c_i32p]
+        lib.rt_route_memo_warm.restype = ctypes.c_int64
+        lib.rt_route_memo_warm.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, c_i32p, c_i32p,
+            ctypes.c_double]
         lib.rt_candidates.argtypes = [
             ctypes.c_void_p, ctypes.c_int64, c_f64p, c_f64p, ctypes.c_int32,
             ctypes.c_double, c_i32p, c_f32p, c_f32p, c_f32p, c_f32p]
@@ -700,3 +711,34 @@ class NativeRuntime:
         self._lib.rt_route_memo_stats(self._handle, out)
         return {"hits": int(out[0]), "misses": int(out[1]),
                 "size": int(out[2]), "evictions": int(out[3])}
+
+    def route_memo_export(self, cap: int = 1 << 16):
+        """Resident (edge_from, edge_to) pairs of the route memo as two
+        int32 arrays — the per-city profile artifact's payload. The
+        clock eviction keeps residents biased hot, so a post-replay
+        export is the city's top route pairs."""
+        self._check_owner()
+        ea = np.empty(cap, dtype=np.int32)
+        eb = np.empty(cap, dtype=np.int32)
+        n = int(self._lib.rt_route_memo_export(self._handle, cap, ea, eb))
+        return ea[:n].copy(), eb[:n].copy()
+
+    def route_memo_warm(self, edge_from, edge_to,
+                        bound_m: float = 500.0) -> int:
+        """Insert the given pairs' node kernels into the route memo
+        (computed with the same bounded Dijkstra the serving path runs
+        on a miss — bit-identical admissibility on later hits). Pairs
+        are sorted by from-edge first so consecutive pairs share one
+        search. Returns pairs inserted; 0 when the memo is disabled."""
+        self._check_owner()
+        ea = np.ascontiguousarray(edge_from, dtype=np.int32)
+        eb = np.ascontiguousarray(edge_to, dtype=np.int32)
+        if ea.shape != eb.shape:
+            raise ValueError("edge_from/edge_to must share a shape")
+        if ea.size == 0:
+            return 0
+        order = np.lexsort((eb, ea))
+        ea = np.ascontiguousarray(ea[order])
+        eb = np.ascontiguousarray(eb[order])
+        return int(self._lib.rt_route_memo_warm(
+            self._handle, ea.shape[0], ea, eb, float(bound_m)))

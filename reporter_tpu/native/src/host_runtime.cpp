@@ -277,6 +277,27 @@ class PairMemo {
     }
   }
 
+  // Dump up to `cap` resident (edge_from, edge_to) pairs, stripe
+  // order; returns the count written. The clock eviction keeps the
+  // memo's residents biased hot, so a post-replay dump IS the city's
+  // top route pairs — the per-city profile artifact the serving tier
+  // pre-warms a freshly loaded city from (datastore/profile.py).
+  int64_t export_pairs(int64_t cap, int32_t* ea_out, int32_t* eb_out) {
+    int64_t n = 0;
+    for (auto& s : stripes_) {
+      std::lock_guard<std::mutex> lk(s.mu);
+      for (auto& kv : s.rows) {
+        for (size_t i = 0; i < kv.second.ebs.size(); ++i) {
+          if (n >= cap) return n;
+          ea_out[n] = kv.first;
+          eb_out[n] = kv.second.ebs[i];
+          ++n;
+        }
+      }
+    }
+    return n;
+  }
+
   // out[4] = {hits, misses, size, evictions}
   void stats(int64_t out[4]) {
     out[0] = out[1] = out[2] = out[3] = 0;
@@ -964,7 +985,7 @@ extern "C" {
 // numpy path loudly instead of calling through a stale signature. BUMP
 // THIS on ANY change to the signatures below, in the same commit as the
 // Python-side constant.
-int32_t rt_abi_version(void) { return 12; }
+int32_t rt_abi_version(void) { return 13; }
 
 void* rt_graph_create(int64_t n_nodes, int64_t n_edges,
                       const double* node_x, const double* node_y,
@@ -1000,6 +1021,69 @@ void rt_cache_clear(void* handle) {
 // {hits, misses, size, evictions} of the cross-call route-pair memo
 void rt_route_memo_stats(void* handle, int64_t* out4) {
   static_cast<Graph*>(handle)->pair_memo.stats(out4);
+}
+
+// Dump up to `cap` resident route-memo pairs into ea/eb (profile
+// export); returns the count written.
+int64_t rt_route_memo_export(void* handle, int64_t cap, int32_t* ea_out,
+                             int32_t* eb_out) {
+  return static_cast<Graph*>(handle)->pair_memo.export_pairs(cap, ea_out,
+                                                             eb_out);
+}
+
+// Pre-warm the cross-call route-pair memo from a profile artifact's
+// (edge_from, edge_to) pairs: each pair's node kernel is computed
+// exactly like route_step's miss path — a bounded Dijkstra from
+// edge_from's end node under the same stripe lock — so a warmed entry
+// is bit-identical to what the serving path would compute and cache on
+// first contact. Consecutive same-ea pairs (the export order) share
+// one search and one batched memo insert. Out-of-range edge ids (a
+// profile from a different graph build) are skipped, not fatal.
+// Returns pairs inserted; 0 when the memo is disabled.
+int64_t rt_route_memo_warm(void* handle, int64_t n, const int32_t* ea,
+                           const int32_t* eb, double bound_m) {
+  auto* g = static_cast<Graph*>(handle);
+  if (!g->pair_memo.enabled()) return 0;
+  const float bound = static_cast<float>(bound_m);
+  int64_t warmed = 0;
+  int64_t i = 0;
+  std::vector<int32_t> ebs;
+  std::vector<PairVal> vals;
+  while (i < n) {
+    const int32_t a = ea[i];
+    if (a < 0 || a >= g->n_edges) {
+      ++i;
+      continue;
+    }
+    ebs.clear();
+    vals.clear();
+    const int32_t src = g->edge_end[a];
+    {
+      // lock held across compute AND reads of the returned map — same
+      // contract as route_step's miss path (a concurrent bound
+      // extension move-assigns the cached map)
+      std::lock_guard<std::mutex> lock(g->stripe_for(src).mu);
+      float covered = bound;
+      const auto& dist = g->dists_from(src, bound, &covered);
+      for (; i < n && ea[i] == a; ++i) {
+        const int32_t b = eb[i];
+        if (b < 0 || b >= g->n_edges) continue;
+        const Graph::DistTime* it = dist.find(g->edge_start[b]);
+        vals.push_back(it == nullptr
+                           ? PairVal{kUnreachable, 0.0f, covered}
+                           : PairVal{it->d, it->t, covered});
+        ebs.push_back(b);
+      }
+    }
+    if (!ebs.empty()) {
+      auto& sp = g->pair_memo.stripe(a);
+      std::lock_guard<std::mutex> lk(sp.mu);
+      g->pair_memo.put_row_locked(sp, a, ebs.size(), ebs.data(),
+                                  vals.data());
+      warmed += static_cast<int64_t>(ebs.size());
+    }
+  }
+  return warmed;
 }
 
 int64_t rt_cache_size(void* handle) {

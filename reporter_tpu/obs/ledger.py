@@ -112,6 +112,11 @@ def entry_from_bench(parsed: dict, source: str, label: str, kind: str,
     # same-box ratio pair, gated by perf_gate --max-padding-waste
     if isinstance(parsed.get("bucketing"), dict):
         entry["bucketing"] = parsed["bucketing"]
+    # the serving-tier batched-query pair (ISSUE 14): query_many(256)
+    # vs 256 single queries over the same store — another same-box
+    # ratio pair, gated by perf_gate --min-query-ratio
+    if isinstance(parsed.get("query"), dict):
+        entry["query"] = parsed["query"]
     return entry
 
 

@@ -1,0 +1,307 @@
+"""Multi-tenant city residency: several cities hot in one fleet.
+
+One serving process used to mean one graph + one datastore. The
+multi-city tier routes every ``city=``-tagged request through a
+:class:`CityRegistry`: a byte-budgeted LRU (Hermes-style memory-budgeted
+residency) of fully wired per-city stacks — graph, matcher (with its
+native runtime), dispatcher and datastore. A request for a non-resident
+city LOADS it (evicting the least-recently-used city once the budget is
+exceeded) and pre-warms the native route-pair memo from the city's
+committed ``.profile`` artifact (datastore/profile.py), so the first
+request batch of a newly resident city hits a warm memo instead of
+paying every pair's Dijkstra cold.
+
+Configuration is the service config's ``cities`` map::
+
+    {"cities": {"metro-a": {"graph": "a.npz", "datastore": "/data/a",
+                            "profile": "/data/a/.profile"}}}
+
+(``profile`` defaults to ``<datastore>/.profile``; either key may be
+omitted — a city can serve /report without a datastore and vice versa.)
+
+``REPORTER_TPU_CITY_BUDGET_MB`` bounds resident graph bytes (default
+512 MB; the most recently used city is never evicted, so one oversized
+city still serves). Counters surface as ``datastore.city.*``; /health
+and /profile carry the residency table.
+"""
+from __future__ import annotations
+
+import logging
+from collections import OrderedDict
+from typing import Callable, Dict, Optional
+
+import numpy as np
+
+from ..utils import metrics
+from ..utils import locks as _locks
+
+logger = logging.getLogger("reporter_tpu.service")
+
+
+def city_budget_bytes() -> int:
+    from ..utils.runtime import _env_float
+    return int(_env_float("REPORTER_TPU_CITY_BUDGET_MB", 512.0)
+               * 1024 * 1024)
+
+
+def _graph_bytes(net) -> int:
+    """Resident-size estimate of one city: the graph's numpy columns
+    (the dominant term; the native handle mirrors the same columns, so
+    this undercounts by a small constant factor — the budget is a
+    residency bound, not an allocator)."""
+    total = 0
+    for v in vars(net).values():
+        if isinstance(v, np.ndarray):
+            total += v.nbytes
+    return total
+
+
+class CityEntry:
+    """One resident city's wired stack."""
+
+    def __init__(self, name: str, service, size_bytes: int,
+                 warmed_pairs: int = 0):
+        self.name = name
+        self.service = service
+        self.size_bytes = size_bytes
+        self.warmed_pairs = warmed_pairs
+        # in-flight request pins (registry._reslock guards both): an
+        # evicted entry with live pins defers its close to the last
+        # release — eviction must never stop the dispatcher under a
+        # request another handler thread is still serving through it
+        self._refs = 0
+        self._evicted = False
+
+    def close(self) -> None:
+        """Release on eviction: stop the dispatcher's drain thread so
+        the evicted stack cannot outlive its handles; graph/native/mmap
+        memory frees with the last reference."""
+        try:
+            self.service.dispatcher.close()
+        except Exception as e:
+            logger.warning("evicting %s: dispatcher close failed: %s",
+                           self.name, e)
+
+    def snapshot(self) -> dict:
+        m = self.service.matcher
+        memo = m.runtime.route_memo_stats() if m.runtime is not None \
+            else None
+        return {"size_bytes": self.size_bytes,
+                # the cold-start counter pair: warmed_pairs > 0 with
+                # memo hits climbing on the first batch is the pre-warm
+                # working; a cold load shows 0 / all-miss
+                "warmed_pairs": self.warmed_pairs,
+                "route_memo": memo,
+                "datastore": self.service.datastore is not None}
+
+
+class CityRegistry:
+    """Byte-budgeted LRU of :class:`CityEntry` (see module docstring).
+
+    ``loader`` (tests, harnesses) overrides the config-driven build:
+    ``loader(name) -> (service, size_bytes_or_None)``.
+    """
+
+    def __init__(self, config: Optional[Dict[str, dict]] = None,
+                 budget_bytes: Optional[int] = None,
+                 loader: Optional[Callable] = None):
+        self.config = dict(config or {})
+        self._budget = budget_bytes
+        self.loader = loader
+        # long_hold_ok: a miss loads a whole city (graph parse + native
+        # build + memo pre-warm — seconds) under the lock by design;
+        # residency swaps must be serialised, and concurrent requests
+        # for the loading city want exactly this wait
+        self._lock = _locks.new_lock("datastore.cities",
+                                     long_hold_ok=True)
+        # the resident MAP has its own tiny lock so /health and
+        # /profile snapshots (and pin/release) never wait out a
+        # multi-second city load; order is always _lock -> _reslock
+        self._reslock = _locks.new_lock("datastore.cities.resident")
+        self._resident: "OrderedDict[str, CityEntry]" = OrderedDict()
+
+    @property
+    def budget_bytes(self) -> int:
+        return self._budget if self._budget is not None \
+            else city_budget_bytes()
+
+    def known(self) -> list:
+        names = set(self.config)
+        if self.loader is not None:
+            with self._reslock:
+                names |= set(self._resident)
+        return sorted(names)
+
+    # -- residency ---------------------------------------------------------
+    def _hit(self, name: str, pin: bool) -> Optional[CityEntry]:
+        """Resident-map lookup under the TINY lock only: a request for
+        an already-loaded city must never wait out another city's
+        multi-second load. The pin increments INSIDE the same critical
+        section — a pin taken after the lock drops could race an
+        eviction closing the entry first."""
+        with self._reslock:
+            got = self._resident.get(name)
+            if got is not None:
+                # LD001 reads the big registry lock as this map's
+                # guard (most writes sit inside both); the map's real
+                # guard is _reslock, which THIS block holds — the hot
+                # hit path skipping _lock is the whole point (a
+                # resident city must not wait out another's load)
+                self._resident.move_to_end(name)  # lint: ignore[LD001]
+                if pin:
+                    got._refs += 1
+        return got
+
+    def get(self, name: str, pin: bool = False) -> CityEntry:
+        """The city's entry, loading (and pre-warming) on a miss. A
+        miss loads the whole city UNDER the registry lock (LD003-style
+        hold by design — see the lock's long_hold_ok note above:
+        residency swaps must serialise, and concurrent requests for
+        the loading city want exactly this wait); resident HITS take
+        only the tiny map lock; evicted stacks are closed after the
+        locks drop."""
+        got = self._hit(name, pin)
+        if got is not None:
+            metrics.count("datastore.city.hits")
+            return got
+        evicted = []
+        try:
+            with self._lock:  # lint: ignore[LD003]
+                got = self._hit(name, pin)  # loaded while we waited
+                if got is not None:
+                    metrics.count("datastore.city.hits")
+                    return got
+                if self.loader is None and name not in self.config:
+                    raise KeyError(
+                        f"unknown city {name!r}; configured: "
+                        f"{sorted(self.config)}")
+                metrics.count("datastore.city.misses")
+                entry = self._load(name)
+                with self._reslock:
+                    self._resident[name] = entry
+                    if pin:
+                        entry._refs += 1
+                    # drop LRU cities until resident bytes fit the
+                    # budget; the most recent stays regardless (one
+                    # oversized city must still serve)
+                    budget = self.budget_bytes
+                    while len(self._resident) > 1 and \
+                            sum(e.size_bytes for e
+                                in self._resident.values()) > budget:
+                        ename, e = self._resident.popitem(last=False)
+                        e._evicted = True
+                        metrics.count("datastore.city.evictions")
+                        if e._refs <= 0:
+                            evicted.append((ename, e))
+                        # else: a handler is mid-request through this
+                        # entry — release() closes it at the last unpin
+                return entry
+        finally:
+            for ename, e in evicted:
+                logger.info("evicting city %s (%.1f MB) over the "
+                            "residency budget", ename,
+                            e.size_bytes / 1e6)
+                e.close()
+
+    def acquire(self, name: str) -> CityEntry:
+        """``get`` plus a pin taken under the map lock: the entry
+        cannot be closed (only unmapped) until the matching
+        :meth:`release` — the request-routing spelling
+        (server._route)."""
+        return self.get(name, pin=True)
+
+    def release(self, entry: CityEntry) -> None:
+        """Unpin; closes an entry the LRU evicted mid-request once the
+        last in-flight request drains off it."""
+        with self._reslock:
+            entry._refs -= 1
+            close_now = entry._evicted and entry._refs <= 0
+        if close_now:
+            logger.info("closing evicted city %s after its last "
+                        "in-flight request", entry.name)
+            entry.close()
+
+    def _load(self, name: str) -> CityEntry:
+        with metrics.timer("datastore.city.load"):
+            if self.loader is not None:
+                service, size = self.loader(name)
+                if size is None:
+                    size = _graph_bytes(service.matcher.net)
+                entry = CityEntry(name, service, size)
+            else:
+                entry = self._load_from_config(name)
+            # pre-warm AFTER the stack is wired: the profile artifact's
+            # resident pairs land in the fresh native memo so the first
+            # request batch hits instead of running every Dijkstra cold
+            from ..datastore import load_profile, warm_matcher
+            from ..datastore.profile import profile_path
+            conf = self.config.get(name, {})
+            ppath = conf.get("profile")
+            if ppath is None and conf.get("datastore"):
+                ppath = profile_path(conf["datastore"])
+            if ppath is None and entry.service.datastore is not None:
+                ppath = profile_path(entry.service.datastore.root)
+            if ppath:
+                try:
+                    entry.warmed_pairs = warm_matcher(
+                        entry.service.matcher, load_profile(ppath))
+                except Exception as e:
+                    # the pre-warm is an optimisation: it must never
+                    # cost the city load
+                    logger.warning("profile pre-warm of %s failed "
+                                   "(loading cold): %s", name, e)
+            metrics.count("datastore.city.loads")
+            logger.info("city %s resident: %.1f MB, %d memo pairs "
+                        "pre-warmed", name, entry.size_bytes / 1e6,
+                        entry.warmed_pairs)
+            return entry
+
+    def _load_from_config(self, name: str) -> CityEntry:
+        from ..graph.network import RoadNetwork
+        from ..matcher import SegmentMatcher
+        from .server import ReporterService
+        conf = self.config[name]
+        if not conf.get("graph"):
+            raise ValueError(f"city {name!r} has no 'graph' configured")
+        net = RoadNetwork.load(conf["graph"])
+        datastore = None
+        if conf.get("datastore"):
+            from ..datastore import LocalDatastore
+            datastore = LocalDatastore(conf["datastore"])
+        service = ReporterService(SegmentMatcher(net=net),
+                                  datastore=datastore)
+        return CityEntry(name, service, _graph_bytes(net))
+
+    def evict(self, name: str) -> bool:
+        """Explicit eviction (tests, admin); pinned entries close at
+        their last release like LRU-evicted ones. Takes the registry
+        lock too (same _lock -> _reslock order as get), so an explicit
+        eviction serialises with in-progress loads."""
+        with self._lock, self._reslock:
+            entry = self._resident.pop(name, None)
+            if entry is not None:
+                entry._evicted = True
+                close_now = entry._refs <= 0
+        if entry is None:
+            return False
+        metrics.count("datastore.city.evictions")
+        if close_now:
+            entry.close()
+        return True
+
+    # -- introspection -----------------------------------------------------
+    def snapshot(self) -> dict:
+        # tiny lock only: /health and /profile must never wait out a
+        # city load; per-entry stats (a quick C counter read) happen
+        # on the copied list
+        with self._reslock:
+            entries = list(self._resident.items())
+        resident = {name: e.snapshot() for name, e in entries}
+        return {"budget_bytes": self.budget_bytes,
+                "resident_bytes": sum(e["size_bytes"]
+                                      for e in resident.values()),
+                "configured": sorted(self.config),
+                "resident": resident}
+
+
+__all__ = ["CityRegistry", "CityEntry", "city_budget_bytes"]

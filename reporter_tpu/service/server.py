@@ -59,10 +59,18 @@ class ReporterService:
                  threshold_sec: int | None = None,
                  max_batch: int | None = None,
                  max_wait_ms: float | None = None,
-                 datastore=None):
+                 datastore=None, cities=None):
         self.matcher = matcher
         # optional LocalDatastore serving /histogram (None = 503 there)
         self.datastore = datastore
+        # optional CityRegistry (service/cities.py): requests carrying
+        # a ``city`` key route to that city's resident stack (loaded
+        # through the byte-budgeted LRU with route-memo pre-warm);
+        # requests without one serve this default matcher/datastore
+        self.cities = cities
+        # optional BackgroundCompactor attached by the owning harness/
+        # worker — /health surfaces its delta-pressure backlog gauge
+        self.compactor = None
         from ..utils.runtime import _env_float, _env_int
         self.threshold_sec = threshold_sec if threshold_sec is not None else \
             _env_int("THRESHOLD_SEC", 15)
@@ -89,6 +97,9 @@ class ReporterService:
         path) — _respond writes it to the socket as is; error bodies
         stay str. Validation messages mirror the reference
         (reporter_service.py:209-245)."""
+        routed = self._route(trace, "handle")
+        if routed is not None:
+            return routed
         if trace.get("uuid") is None:
             return 400, '{"error":"uuid is required"}'
         try:
@@ -123,18 +134,56 @@ class ReporterService:
         except Exception as e:
             return 500, json.dumps({"error": str(e)})
 
+    def _route(self, req: dict, method: str):
+        """City routing (service/cities.py): a ``city`` key sends this
+        request to that city's resident stack — loading it through the
+        LRU (with route-memo pre-warm) on a miss. Returns the routed
+        (status, body), an error response for an unknown city, or None
+        to serve from this default stack."""
+        city = req.get("city")
+        if city is None:
+            return None
+        if self.cities is None:
+            return 400, json.dumps(
+                {"error": "no city registry attached; this fleet "
+                          "serves a single city"})
+        try:
+            # acquire/release pin: the LRU may evict this city while
+            # the request is in flight — the entry's dispatcher then
+            # closes at our release, never underneath us
+            entry = self.cities.acquire(str(city))
+        except KeyError as e:
+            return 400, json.dumps({"error": str(e).strip("'\"")})
+        except Exception as e:
+            return 500, json.dumps({"error": f"city load failed: {e}"})
+        try:
+            sub = {k: v for k, v in req.items() if k != "city"}
+            return getattr(entry.service, method)(sub)
+        finally:
+            self.cities.release(entry)
+
     def histogram(self, params: dict) -> tuple[int, str]:
         """Answer a /histogram query; (status, body). ``params`` carries
-        ``segment_id`` (required) plus optional ``hours`` (list of
-        hour-of-week ints), ``time_range`` ([t0, t1) epoch seconds,
-        converted to the hour set it covers), and ``percentiles``."""
+        ONE of ``segment_id`` (single), ``segments`` (batched: answered
+        through one ``query_many`` sweep) or ``bbox`` + ``level``
+        (every resident segment of that level inside the lon/lat box),
+        plus optional ``hours`` (list of hour-of-week ints),
+        ``time_range`` ([t0, t1) epoch seconds, converted to the hour
+        set it covers), ``percentiles``, and ``city`` (multi-tenant
+        routing)."""
+        routed = self._route(params, "histogram")
+        if routed is not None:
+            return routed
         if self.datastore is None:
             return 503, ('{"error":"no datastore attached; serve with a '
                          '--datastore directory"}')
         from ..datastore import DEFAULT_PERCENTILES, hours_for_range
         seg = params.get("segment_id")
-        if seg is None:
-            return 400, '{"error":"segment_id is required"}'
+        segs = params.get("segments")
+        bbox = params.get("bbox")
+        if seg is None and segs is None and bbox is None:
+            return 400, ('{"error":"one of segment_id, segments or '
+                         'bbox (+level) is required"}')
         hours = params.get("hours")
         if hours is None and params.get("time_range") is not None:
             try:
@@ -143,11 +192,23 @@ class ReporterService:
                 return 400, ('{"error":"time_range must be a [start, end) '
                              'epoch-seconds pair"}')
             hours = hours_for_range(int(t0), int(t1)).tolist()
+        pcts = tuple(params.get("percentiles") or DEFAULT_PERCENTILES)
         try:
-            result = self.datastore.query(
-                int(seg), hours=hours,
-                percentiles=tuple(params.get("percentiles")
-                                  or DEFAULT_PERCENTILES))
+            if bbox is not None:
+                if params.get("level") is None:
+                    return 400, ('{"error":"bbox queries need a level '
+                                 '(0, 1 or 2)"}')
+                result = self.datastore.query_bbox(
+                    bbox, int(params["level"]), hours=hours,
+                    percentiles=pcts,
+                    max_segments=params.get("max_segments"))
+            elif segs is not None:
+                result = {"results": self.datastore.query_many(
+                    [int(s) for s in segs], hours=hours,
+                    percentiles=pcts)}
+            else:
+                result = self.datastore.query(int(seg), hours=hours,
+                                              percentiles=pcts)
         except (TypeError, ValueError) as e:
             return 400, json.dumps({"error": str(e)})
         return 200, json.dumps(result, separators=(",", ":"))
@@ -207,10 +268,23 @@ class ReporterService:
                 stats = self.datastore.stats()
                 body["datastore"] = {"status": "ok",
                                      "partitions": stats["partitions"],
-                                     "rows": stats["rows"]}
+                                     "rows": stats["rows"],
+                                     # writer-lease holder view: which
+                                     # pid owns mutations on this store
+                                     # root right now (multi-process
+                                     # serving shares the root)
+                                     "lease": self.datastore.lease
+                                     .snapshot()}
             except Exception as e:
                 body["datastore"] = {"status": "error", "error": str(e)}
                 healthy = False
+        if self.compactor is not None:
+            # delta-pressure backlog gauge (cached last sweep): a
+            # growing backlog means compaction is falling behind the
+            # tee — visible here long before queries slow down
+            body["compaction"] = self.compactor.pending()
+        if self.cities is not None:
+            body["cities"] = self.cities.snapshot()
         body["status"] = "ok" if healthy else "degraded"
         return (200 if healthy else 503,
                 json.dumps(body, separators=(",", ":")))
@@ -290,6 +364,20 @@ def make_handler(service: ReporterService):
             out: dict = {}
             if "segment_id" in params:
                 out["segment_id"] = int(params["segment_id"][0])
+            # repeated segment params: ?segment=A&segment=B&... —
+            # served through ONE query_many sweep
+            if "segment" in params:
+                out["segments"] = [int(s) for s in params["segment"]]
+            # ?bbox=min_lon,min_lat,max_lon,max_lat&level=L
+            if "bbox" in params:
+                out["bbox"] = [float(v) for v
+                               in params["bbox"][0].split(",")]
+            if "level" in params:
+                out["level"] = int(params["level"][0])
+            if "max_segments" in params:
+                out["max_segments"] = int(params["max_segments"][0])
+            if "city" in params:
+                out["city"] = params["city"][0]
             if "hours" in params:
                 from ..datastore import parse_hours_spec
                 out["hours"] = parse_hours_spec(params["hours"][0])
@@ -316,7 +404,13 @@ def make_handler(service: ReporterService):
                 return
             if action == "profile":
                 from ..obs import profiler
-                self._respond(200, json.dumps(profiler.snapshot(),
+                prof = profiler.snapshot()
+                if service.cities is not None:
+                    # the residency table with each city's route-memo
+                    # counters + warmed_pairs: the cold-start pair a
+                    # pre-warm assertion reads (serve_smoke)
+                    prof["cities"] = service.cities.snapshot()
+                self._respond(200, json.dumps(prof,
                                               separators=(",", ":")))
                 return
             if action == "health":
@@ -514,7 +608,15 @@ def main(argv=None):
         # are set; single-host no-op otherwise
         from ..parallel import init_multihost
         init_multihost()
-        return ReporterService(SegmentMatcher(), datastore=datastore)
+        # a "cities" map in the config mounts the multi-tenant registry
+        # (service/cities.py): city=-tagged requests route through the
+        # byte-budgeted residency LRU with route-memo pre-warm
+        cities = None
+        if conf.get("cities"):
+            from .cities import CityRegistry
+            cities = CityRegistry(conf["cities"])
+        return ReporterService(SegmentMatcher(), datastore=datastore,
+                               cities=cities)
 
     if procs > 1:
         from .prefork import serve_prefork

@@ -211,7 +211,9 @@ class DeadLetterDrainer:
             payload = f.read()
         if self.datastore is not None:
             # idempotent (ledger key == the relpath the tee stamped);
-            # raises on a down store -> counted failure, backed off
+            # raises on a down store — or a writer lease another
+            # process holds (LeaseHeldElsewhere) — -> counted failure,
+            # backed off, retried after the holder's TTL
             from ..datastore import parse_tile_csv
             self.datastore.ingest(parse_tile_csv(payload),
                                   ingest_key=f"{tile_name}/{file_name}")

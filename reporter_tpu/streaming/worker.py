@@ -92,7 +92,7 @@ class StreamWorker:
                  trace_deadletter: Optional[str] = None,
                  circuit_probe: Optional[Callable[[], str]] = None,
                  degraded_probe: Optional[Callable[[], list]] = None,
-                 datastore=None):
+                 datastore=None, compactor=None):
         self.formatter = formatter
         # multi-host: predicate deciding which uuids this worker owns
         # (parallel.multihost — the Kafka keyed-partition contract when the
@@ -173,6 +173,14 @@ class StreamWorker:
         self.degraded_probe = degraded_probe
         self._hb_last = time.monotonic()
         self._hb_processed = 0
+        # background compaction (datastore/compactor.py): the delta-
+        # pressure policy off the flush hot path — the tee ingest no
+        # longer compacts inline; the paced thread (lease-gated) does.
+        # Owned here so drain() can stop it in dependency order.
+        self.compactor = compactor
+        self.datastore = datastore
+        if compactor is not None:
+            compactor.start()
         # durable state (StateStore): restore open batches + tile slices
         # from the last snapshot — the reference instead loses in-memory
         # state on crash (BatchingProcessor.java:20-22, SURVEY.md §5)
@@ -280,6 +288,10 @@ class StreamWorker:
                 "tiles": spool_mod.backlog_cached(self._tile_spool),
                 "traces": spool_mod.backlog_cached(self._trace_spool)},
             "parse_failures": self.parse_failures,
+            # delta-pressure backlog (cached last compactor sweep):
+            # partitions over pressure waiting on background compaction
+            "datastore_backlog": self.compactor.pending()
+            if self.compactor is not None else None,
             # the device-compute vitals (obs/profiler.py): padding the
             # fixed buckets pay, compile churn, shadow-oracle verdicts
             "padding_waste": round(waste, 4) if waste is not None
@@ -347,8 +359,19 @@ class StreamWorker:
             # caller must not re-enter the submit path or the sink
             self.drainer.pause()
         self._flush_tiles()
+        if self.compactor is not None:
+            # signal + JOIN after the final flush (its deltas were the
+            # last pressure source): no compaction thread may outlive
+            # the store handles this worker is about to release
+            self.compactor.stop()
         if self.state is not None:
             self.state.save(self.batcher, self.anonymiser)
+        if self.datastore is not None:
+            # hand the writer lease back on a CLEAN exit: the
+            # successor then acquires a vacant lease instead of
+            # "stealing" from a dead pid on every routine restart —
+            # steal counters/warnings stay a crash signal
+            self.datastore.lease.release()
 
     def run(self, messages: Iterable[str],
             duration_s: Optional[float] = None) -> None:
@@ -438,14 +461,14 @@ def main(argv=None):
                              "(zero serialisation) so /histogram queries "
                              "work without a separate ingest step")
     parser.add_argument("--datastore-max-deltas", type=int, default=None,
-                        help="automatic compaction: after each tee "
-                             "ingest, compact partitions holding more "
-                             "than N uncompacted deltas")
+                        help="background compaction: compact partitions "
+                             "holding more than N uncompacted deltas "
+                             "(paced thread off the flush path, "
+                             "REPORTER_TPU_COMPACT_INTERVAL_S)")
     parser.add_argument("--datastore-max-delta-bytes", type=int,
                         default=None,
-                        help="automatic compaction: after each tee "
-                             "ingest, compact partitions whose deltas "
-                             "exceed B bytes")
+                        help="background compaction: compact partitions "
+                             "whose uncompacted deltas exceed B bytes")
     parser.add_argument("--deadletter",
                         help="directory spooling tile bodies whose egress "
                              "failed (default <output>/.deadletter for "
@@ -497,20 +520,37 @@ def main(argv=None):
 
     tee = None
     datastore = None
+    compactor = None
     if args.datastore:
-        from ..datastore import LocalDatastore
+        from ..datastore import BackgroundCompactor, LocalDatastore
         datastore = LocalDatastore(args.datastore)
         max_deltas = args.datastore_max_deltas
         max_bytes = args.datastore_max_delta_bytes
+        inline_deltas = inline_bytes = None
+        if max_deltas is not None or max_bytes is not None:
+            # the pressure policy moved OFF the flush hot path: the tee
+            # ingest below never compacts inline any more — the paced
+            # background thread (lease-gated, so exactly one compactor
+            # per store root across processes) sweeps instead. EXCEPT
+            # when the operator disabled the thread
+            # (REPORTER_TPU_COMPACT_INTERVAL_S=0): the explicit
+            # --datastore-max-deltas flags must still mean something,
+            # so the tee falls back to the old inline pressure check
+            from ..datastore.compactor import compact_interval_s
+            if compact_interval_s() > 0:
+                compactor = BackgroundCompactor(datastore,
+                                                max_deltas=max_deltas,
+                                                max_delta_bytes=max_bytes)
+            else:
+                inline_deltas, inline_bytes = max_deltas, max_bytes
 
-        def tee(_tile, segments, ingest_key=None,
-                _ds=datastore, _n=max_deltas, _b=max_bytes):
-            # automatic compaction policy rides the ingest: only the
-            # partitions THIS flush touched are pressure-checked, so a
-            # city-scale store never pays a full-store sweep per flush
-            # (datastore/store.py ingest). ingest_key is the flush
-            # identity the anonymiser stamps — the exactly-once ledger
-            # key that makes crash-replayed flushes idempotent
+        def tee(_tile, segments, ingest_key=None, _ds=datastore,
+                _n=inline_deltas, _b=inline_bytes):
+            # ingest_key is the flush identity the anonymiser stamps —
+            # the exactly-once ledger key that makes crash-replayed
+            # flushes idempotent. A LeaseHeldElsewhere (another process
+            # owns the store) propagates like any tee failure: the
+            # anonymiser spools the tile body for later replay
             return _ds.ingest_segments(segments, max_deltas=_n,
                                        max_delta_bytes=_b,
                                        ingest_key=ingest_key)
@@ -527,7 +567,7 @@ def main(argv=None):
         uuid_filter=uuid_filter, submit_many=submit_many,
         report_flush_interval_s=args.report_flush_interval,
         circuit_probe=circuit_probe, degraded_probe=degraded_probe,
-        datastore=datastore)
+        datastore=datastore, compactor=compactor)
     if not args.reporter_url:
         # poisoned-trace quarantine lands in THIS worker's trace spool
         # (explicit beats the last-writer-wins module global — see

@@ -1,18 +1,30 @@
-"""Datastore CLI: ingest / compact / query / stats over a histogram store.
+"""Datastore CLI: ingest / compact / query / profile over a histogram store.
 
   python -m reporter_tpu datastore ingest  <store> <results-dir> [--delete]
   python -m reporter_tpu datastore compact <store> [--level L] [--index I]
   python -m reporter_tpu datastore query   <store> --segment ID
+                                           [--segments A,B,C]
+                                           [--bbox MINLON,MINLAT,MAXLON,MAXLAT
+                                            --bbox-level L]
                                            [--hours 7-9|7,8,9]
                                            [--t0 EPOCH --t1 EPOCH]
                                            [--percentiles 25,50,75,95]
+  python -m reporter_tpu datastore profile <store> [--graph city.npz
+                                           --replay traces.jsonl]
+                                           [--cap N] [--city NAME]
   python -m reporter_tpu datastore stats   <store>
 
 ``ingest`` replays any directory in the anonymiser's flush layout — a
 results dir OR its ``.deadletter`` spool; ``--delete`` removes each tile
-file after a successful append (the dead-letter replay contract). All
-output is one JSON object per line, metrics timers included, so the
-commands compose in scripts the way bench.py's artifact lines do.
+file after a successful append (the dead-letter replay contract).
+``--segments`` / ``--bbox`` serve many segments through ONE
+``query_many`` sweep per partition (datastore/query.py). ``profile``
+with ``--replay`` runs the request JSONs (one per line) through a
+matcher on ``--graph`` and commits the native route memo's resident
+pairs as the store's ``.profile`` pre-warm artifact; without
+``--replay`` it prints the committed artifact's summary. All output is
+one JSON object per line, metrics timers included, so the commands
+compose in scripts the way bench.py's artifact lines do.
 """
 from __future__ import annotations
 
@@ -22,6 +34,39 @@ import sys
 
 from ..datastore import LocalDatastore, parse_hours_spec
 from ..utils import metrics
+
+
+def _profile(ds, args) -> dict:
+    """``profile`` subcommand body: export (with --replay) or show."""
+    from ..datastore.profile import (
+        export_profile,
+        load_profile,
+        profile_path,
+    )
+    path = args.out or profile_path(ds.root)
+    if args.replay is None:
+        art = load_profile(path)
+        if art is None:
+            return {"path": path, "present": False}
+        return {"path": path, "present": True, "city": art.get("city"),
+                "n_pairs": art.get("n_pairs"),
+                "memo_stats": art.get("memo_stats")}
+    if not args.graph:
+        raise SystemExit("profile --replay needs --graph")
+    from ..graph.network import RoadNetwork
+    from ..matcher import SegmentMatcher
+    matcher = SegmentMatcher(net=RoadNetwork.load(args.graph))
+    reqs = []
+    with open(args.replay, encoding="utf-8") as f:
+        for line in f:
+            if line.strip():
+                reqs.append(json.loads(line))
+    # chunked replay: warm the memo the way serving traffic would
+    for i in range(0, len(reqs), 256):
+        matcher.match_many(reqs[i:i + 256])
+    art = export_profile(matcher, path, cap=args.cap, city=args.city)
+    return {"path": path, "n_pairs": art["n_pairs"],
+            "replayed": len(reqs), "memo_stats": art["memo_stats"]}
 
 
 def main(argv=None):
@@ -49,9 +94,19 @@ def main(argv=None):
                        help="automatic policy: only compact partitions "
                             "whose uncompacted deltas exceed B bytes")
 
-    p_qry = sub.add_parser("query", help="one segment's speed histogram")
+    p_qry = sub.add_parser("query", help="segment speed histograms "
+                           "(single, batched list, or bbox)")
     p_qry.add_argument("store")
-    p_qry.add_argument("--segment", type=int, required=True)
+    p_qry.add_argument("--segment", type=int, default=None)
+    p_qry.add_argument("--segments", default=None,
+                       help="comma-separated ids served through one "
+                            "query_many sweep")
+    p_qry.add_argument("--bbox", default=None,
+                       help="min_lon,min_lat,max_lon,max_lat — every "
+                            "resident segment of --bbox-level inside")
+    p_qry.add_argument("--bbox-level", type=int, default=2)
+    p_qry.add_argument("--max-segments", type=int, default=None,
+                       help="bbox fan-out bound (explicit truncation)")
     p_qry.add_argument("--hours", default=None,
                        help="hour-of-week subset: '7-9' or '7,8,9'")
     p_qry.add_argument("--t0", type=int, default=None,
@@ -60,6 +115,22 @@ def main(argv=None):
     p_qry.add_argument("--t1", type=int, default=None)
     p_qry.add_argument("--percentiles", default=None,
                        help="comma-separated, e.g. 25,50,75,95")
+
+    p_prf = sub.add_parser("profile", help="route-memo pre-warm "
+                           "artifact: export from a replay, or show")
+    p_prf.add_argument("store")
+    p_prf.add_argument("--graph", default=None,
+                       help="RoadNetwork .npz to replay against")
+    p_prf.add_argument("--replay", default=None,
+                       help="request JSONs, one per line (the /report "
+                            "body shape); replayed through match_many "
+                            "to warm the memo before export")
+    p_prf.add_argument("--cap", type=int, default=1 << 16,
+                       help="max pairs exported")
+    p_prf.add_argument("--city", default=None,
+                       help="city name stamped into the artifact")
+    p_prf.add_argument("--out", default=None,
+                       help="artifact path (default <store>/.profile)")
 
     p_sts = sub.add_parser("stats", help="partition/segment/byte totals")
     p_sts.add_argument("store")
@@ -71,10 +142,14 @@ def main(argv=None):
         out = ds.ingest_dir(args.source, delete=args.delete,
                             limit=args.limit)
         out["metrics"] = metrics.snapshot()["timers"]
+        # clean exit hands the writer lease back (a successor acquires
+        # a vacant lease instead of logging a dead-pid steal)
+        ds.lease.release()
     elif args.cmd == "compact":
         out = ds.compact(level=args.level, index=args.index,
                          max_deltas=args.max_deltas,
                          max_delta_bytes=args.max_delta_bytes)
+        ds.lease.release()
     elif args.cmd == "query":
         hours = parse_hours_spec(args.hours)
         if hours is None and args.t0 is not None and args.t1 is not None:
@@ -84,7 +159,21 @@ def main(argv=None):
         if args.percentiles:
             kwargs["percentiles"] = [
                 float(p) for p in args.percentiles.split(",") if p]
-        out = ds.query(args.segment, hours=hours, **kwargs)
+        if args.bbox is not None:
+            bbox = [float(v) for v in args.bbox.split(",")]
+            if args.max_segments is not None:
+                kwargs["max_segments"] = args.max_segments
+            out = ds.query_bbox(bbox, args.bbox_level, hours=hours,
+                                **kwargs)
+        elif args.segments is not None:
+            ids = [int(s) for s in args.segments.split(",") if s]
+            out = {"results": ds.query_many(ids, hours=hours, **kwargs)}
+        elif args.segment is not None:
+            out = ds.query(args.segment, hours=hours, **kwargs)
+        else:
+            parser.error("query needs --segment, --segments or --bbox")
+    elif args.cmd == "profile":
+        out = _profile(ds, args)
     else:
         out = ds.stats()
 

@@ -73,8 +73,8 @@ KINDS = ("error", "timeout", "partial", "crash")
 KNOWN_SITES = frozenset({
     "native.prep", "decode.dispatch", "matcher.assemble",
     "matcher.submit", "egress.http", "datastore.commit",
-    "state.save", "worker.offer", "worker.post_egress",
-    "wire.native",
+    "datastore.compact", "datastore.lease", "state.save",
+    "worker.offer", "worker.post_egress", "wire.native",
 })
 
 #: sites that place an ``after=True`` hook (the only position where

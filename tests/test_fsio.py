@@ -111,8 +111,11 @@ class TestDurableWritersUseTheProtocol:
         stats = ds.stats()
         assert stats["segments"] == 1 and stats["rows"] == 1
         # no stray temp dirs/files in the partition after the commit
+        # (.lease is the writer lease, a live control file — not a
+        # stray temp)
         store_root = tmp_path / "store"
         stray = [os.path.join(d, n)
                  for d, _, names in os.walk(store_root) for n in names
-                 if n.startswith(".") and n != "MANIFEST.json"]
+                 if n.startswith(".") and n not in (".lease",)
+                 and n != "MANIFEST.json"]
         assert stray == [], stray

@@ -409,11 +409,14 @@ def test_abi_bad_fixture_catches_every_drift_class():
     assert rules == {"ABI001", "ABI002", "ABI003", "ABI004", "ABI005"}
 
 
-def test_abi_live_pair_validates_at_version_12():
+def test_abi_live_pair_validates_at_version_13():
     cpp = _read(LIVE_CPP)
     exports, version = abi.parse_cpp(cpp)
-    assert version == 12
+    assert version == 13
     assert "rt_prepare_batch" in exports and "rt_assemble_batch" in exports
+    # the ABI-13 route-memo profile surface (export + pre-warm)
+    assert "rt_route_memo_export" in exports \
+        and "rt_route_memo_warm" in exports
     # the ABI-12 wire writers are part of the checked surface
     assert "rt_report_json" in exports \
         and "rt_report_json_batch" in exports \

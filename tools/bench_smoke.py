@@ -22,7 +22,7 @@ import subprocess
 import sys
 
 REQUIRED_TOP = ("metric", "value", "unit", "vs_baseline", "stages",
-                "report_writers", "baseline", "probe")
+                "report_writers", "baseline", "probe", "query")
 REQUIRED_STAGES = ("prep", "decode_dispatch", "decode_wait", "assemble",
                    "report", "total", "prep_share", "report_share",
                    "pipelined")
@@ -107,6 +107,14 @@ def main(argv=None) -> int:
                 return 1
     if not (art["value"] > 0 and art["vs_baseline"] > 0):
         sys.stderr.write("bench smoke: non-positive throughput\n")
+        return 1
+    # the serving-tier batched-query pair (ISSUE 14): pure numpy, no
+    # native/device dependency — the ratio must always be measured
+    # (parity is asserted inside the leg; perf_gate floors the ratio)
+    query = art.get("query") or {}
+    if not isinstance(query.get("batch_ratio"), (int, float)):
+        sys.stderr.write(
+            f"bench smoke: query.batch_ratio missing: {query}\n")
         return 1
     if args.out:
         with open(args.out, "w", encoding="utf-8") as f:

@@ -266,23 +266,9 @@ def run_leg(metro, writers: int, workdir: str, faults_spec=None,
 
 
 def _store_cells(store):
-    """{(level, index, hist_key): (count, speed_sum)} merged across every
-    committed segment — the exactly-once comparand."""
-    import numpy as np
-
-    from reporter_tpu.datastore import merge_deltas
-    out = {}
-    for level, index in store.partitions():
-        parts = store.live_segments(level, index)
-        if not parts:
-            continue
-        merged = merge_deltas(parts)
-        keys = np.asarray(merged.hist_key)
-        counts = np.asarray(merged.hist_count)
-        sums = np.asarray(merged.hist_speed_sum)
-        for k, c, s in zip(keys.tolist(), counts.tolist(), sums.tolist()):
-            out[(level, index, k)] = (c, round(s, 6))
-    return out
+    """The exactly-once parity comparand — ONE definition, shared with
+    chaos lease_kill (HistogramStore.merged_cells)."""
+    return store.merged_cells()
 
 
 def check_exactly_once(leg, workdir: str):

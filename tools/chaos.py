@@ -23,6 +23,10 @@ Scenarios (run the named ones, default ``storm kill_restore``):
   egress_outage sink down -> every tile dead-letters -> `datastore
                 ingest --delete` replay -> histogram datastore parity
                 with a fault-free run
+  lease_kill    SIGKILL the datastore writer-lease holder mid-compaction
+                -> manifests untorn -> another process steals the dead
+                holder's lease -> recovery replay ledger-deduped ->
+                store cells equal a fresh fault-free ingest
 
 Usage:
   REPORTER_TPU_PLATFORM=cpu python tools/chaos.py [scenario ...]
@@ -528,11 +532,18 @@ def scenario_decode_poison() -> int:
 # ---------------------------------------------------------------------------
 def _store_fingerprint(root: str) -> dict:
     """{relpath: bytes} of a datastore tree — the byte-parity comparand
-    (meta.json excluded: it carries a wall-clock 'created' stamp)."""
+    (meta.json excluded: it carries a wall-clock 'created' stamp; dot
+    files excluded: ``.lease`` carries the holder pid/deadline and
+    ``.profile`` the replay-dependent memo dump — control state, not
+    data, so parity must not read them)."""
     out = {}
-    for dirpath, _dirnames, filenames in os.walk(root):
+    for dirpath, dirnames, filenames in os.walk(root):
+        # dot DIRS too: .tmp- stage dirs and .orphan- asides hold
+        # non-dot column files that are not committed data
+        dirnames[:] = sorted(d for d in dirnames
+                             if not d.startswith("."))
         for name in sorted(filenames):
-            if name == "meta.json":
+            if name == "meta.json" or name.startswith("."):
                 continue
             path = os.path.join(dirpath, name)
             with open(path, "rb") as f:
@@ -950,6 +961,179 @@ def scenario_prefork_kill() -> int:
     return 0
 
 
+def _store_cells(store) -> dict:
+    """The layout-independent parity comparand — ONE definition,
+    shared with bigreplay (HistogramStore.merged_cells)."""
+    return store.merged_cells()
+
+
+def _assert_untorn(store):
+    """Every manifest parses and every segment it lists mmaps with all
+    its columns — the 'no torn manifest' post-crash invariant. Returns
+    an error string or None."""
+    for level, index in store.partitions():
+        pdir = store.partition_dir(level, index)
+        manifest = store._read_manifest(pdir)
+        for name in manifest["segments"]:
+            if store.load_segment(pdir, name) is None:
+                return (f"manifest {level}/{index} lists {name} "
+                        "but its columns are missing — torn commit")
+    return None
+
+
+def scenario_lease_kill() -> int:
+    """The cross-process writer lease under SIGKILL: two writer workers
+    + the dead-letter drainer + the background compactor all pointed at
+    ONE store; the compaction holder is killed mid-commit (crash
+    failpoint in the widest window: base- dir renamed, manifest not yet
+    rewritten); another process steals the dead holder's lease, the
+    manifests are untorn, the exactly-once ledger still dedupes every
+    flush, and the recovered store's cells equal a fault-free ingest of
+    the same tile trees (end-to-end exactly-once under the crash)."""
+    from reporter_tpu.datastore import LocalDatastore, ingest_dir
+    from reporter_tpu.utils import faults as faults_mod
+    from reporter_tpu.utils import metrics
+
+    with tempfile.TemporaryDirectory() as tmp:
+        city = _city()
+        lines = _lines(city, n_traces=8)
+        graph = os.path.join(tmp, "city.npz")
+        city.save(graph)
+        # two writer shards of one stream (the bigreplay ownership
+        # contract): each worker runs with its own writer id, tees into
+        # the SAME store, with the replay drainer + compactor armed
+        shard = [[], []]
+        for ln in lines:
+            shard[hash(ln.split("|", 1)[0]) % 2].append(ln)
+        inputs = []
+        for w, lns in enumerate(shard):
+            p = os.path.join(tmp, f"in-{w}.txt")
+            with open(p, "w") as f:
+                f.write("\n".join(lns) + "\n")
+            inputs.append(p)
+
+        def cmd(inp, out_dir, store):
+            return [sys.executable, "-m", "reporter_tpu", "stream",
+                    "-f", FMT, "--graph", graph, "-p", "1", "-q", "3600",
+                    "-i", "0", "-s", "chaos", "-o", out_dir,
+                    "--input", inp, "--uuid-filter", "off",
+                    "-r", "0,1,2", "-x", "0,1,2",
+                    "--datastore", store,
+                    "--datastore-max-deltas", "1",
+                    # continuous report flushes -> many tee ingests per
+                    # partition -> real delta pressure for the paced
+                    # compactor to crash inside
+                    "--report-flush-interval", "0"]
+
+        def run_shard(w, store, out_prefix, env):
+            out_dir = os.path.join(tmp, f"{out_prefix}-{w}")
+            e = dict(env, REPORTER_TPU_WRITER_ID=f"w{w}")
+            p = subprocess.run(cmd(inputs[w], out_dir, store), env=e,
+                               cwd=REPO, capture_output=True,
+                               text=True, timeout=600)
+            return out_dir, p
+
+        base_env = dict(os.environ, REPORTER_TPU_PLATFORM="cpu",
+                        REPORTER_TPU_COMPACT_INTERVAL_S="0.05",
+                        REPORTER_TPU_REPLAY_INTERVAL_S="0.2",
+                        REPORTER_TPU_STORE_LEASE_S="30")
+        base_env.pop("REPORTER_TPU_FAULTS", None)
+
+        # chaos leg: writer 0 crashes mid-compaction HOLDING the lease;
+        # writer 1 then runs fault-free against the dead holder's store
+        # (its first mutation steals the stale lease in-process)
+        store_chaos = os.path.join(tmp, "store_chaos")
+        outs = []
+        out_dir, p = run_shard(0, store_chaos, "chaos", dict(
+            base_env, REPORTER_TPU_FAULTS="datastore.compact=crash#1"))
+        outs.append(out_dir)
+        if p.returncode != faults_mod.CRASH_EXIT_CODE:
+            return fail(f"chaos writer 0 rc={p.returncode} "
+                        f"(want {faults_mod.CRASH_EXIT_CODE}): "
+                        f"{p.stderr[-2000:]}")
+
+        # no torn manifest anywhere, despite the mid-commit SIGKILL
+        ds = LocalDatastore(store_chaos)
+        err = _assert_untorn(ds)
+        if err:
+            return fail(err)
+
+        # THIS process is "another process": the SIGKILLed holder
+        # never released (a clean exit would have), so our first
+        # mutation must STEAL the dead pid's lease (expiry covers the
+        # stuck-alive case) — the steal counter is the crash signal
+        metrics.default.reset()
+        ingest_dir(ds, out_dir)
+        snap = metrics.default.snapshot()["counters"]
+        if not snap.get("datastore.lease.steals"):
+            return fail(f"no lease steal counted after holder death: "
+                        f"{ {k: v for k, v in snap.items() if 'lease' in k} }")
+        # hand it back so writer 1 serves the same store CLEANLY
+        # (vacant acquire, no steal — routine-restart semantics)
+        ds.lease.release()
+
+        out_dir, p = run_shard(1, store_chaos, "chaos", base_env)
+        outs.append(out_dir)
+        if p.returncode != 0:
+            return fail(f"chaos writer 1 rc={p.returncode}: "
+                        f"{p.stderr[-2000:]}")
+
+        # recovery must converge the store: replay every sink tree
+        # (ledger-deduped for flushes the tees already committed,
+        # fresh appends for any the crash lost) and finish the
+        # interrupted compaction
+        metrics.default.reset()
+        for out_dir in outs:
+            ingest_dir(ds, out_dir)
+        ds.compact(max_deltas=0)
+        snap = metrics.default.snapshot()["counters"]
+        if not snap.get("datastore.ingest.deduped"):
+            return fail("ledger deduped nothing on the recovery replay "
+                        "— exactly-once ledger lost in the crash")
+
+        # end-to-end exactly-once parity: the recovered tee store must
+        # equal a FRESH, fault-free ingest of the same tile trees cell
+        # for cell — every observation that reached a tile is counted
+        # exactly once despite the crash, steal and replay (layouts
+        # differ — compaction points differ — so cells, not bytes)
+        ref = LocalDatastore(os.path.join(tmp, "store_fresh"))
+        for out_dir in outs:
+            ingest_dir(ref, out_dir)
+        if _store_cells(ds) != _store_cells(ref):
+            return fail("recovered store cells differ from a fresh "
+                        "fault-free ingest of the same tiles")
+        # and a SECOND replay into the recovered store appends nothing
+        before = _store_cells(ds)
+        for out_dir in outs:
+            got = ingest_dir(ds, out_dir)
+            if got["rows"]:
+                return fail(f"re-ingest appended {got['rows']} rows — "
+                            "ledger failed to dedupe after the crash")
+        if _store_cells(ds) != before:
+            return fail("re-ingest changed store cells despite 0 rows")
+
+        # the datastore.lease failpoint: an injected lease-layer fault
+        # refuses the mutation loudly (callers spool/retry) instead of
+        # proceeding on an unknown lease state
+        faults_mod.configure("datastore.lease=error#1")
+        try:
+            ds.lease._deadline = 0.0  # force the slow path
+            try:
+                ds.compact(max_deltas=0)
+                return fail("datastore.lease=error did not refuse the "
+                            "mutation")
+            except Exception:
+                pass
+        finally:
+            faults_mod.clear()
+
+    log("lease_kill ok: mid-compaction SIGKILL left no torn manifest, "
+        "the next process stole the dead holder's lease, ledger "
+        "deduped the replay, store cells equal a fresh fault-free "
+        "ingest of the same tiles")
+    return 0
+
+
 SCENARIOS = {
     "storm": scenario_storm,
     "kill_restore": scenario_kill_restore,
@@ -959,6 +1143,7 @@ SCENARIOS = {
     "decode_poison": scenario_decode_poison,
     "double_ingest": scenario_double_ingest,
     "replay_drain": scenario_replay_drain,
+    "lease_kill": scenario_lease_kill,
 }
 
 

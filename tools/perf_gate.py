@@ -212,6 +212,36 @@ def gate_padding_waste(candidate: dict, ceiling: float
     return (not verdict["failures"]), verdict
 
 
+def gate_query_ratio(candidate: dict, floor: float) -> Tuple[bool, dict]:
+    """Floor on the serving-tier batched-query speedup
+    (``--min-query-ratio 5``): the ISSUE-14 acceptance number — ONE
+    ``query_many(256)`` sweep must answer at least ``floor``x faster
+    than 256 single queries (bench.py's ``query`` block; answers are
+    parity-asserted inside the leg before timing). A candidate without
+    the block fails loudly; an explicit ``error`` record fails with the
+    recorded reason — a silently missing ratio must never pass a floor
+    the caller believes binds."""
+    query = candidate.get("query") or {}
+    ratio = query.get("batch_ratio")
+    verdict: dict = {"candidate": {"source": candidate.get("source"),
+                                   "query": query or None},
+                     "min_query_ratio": floor, "failures": []}
+    if query.get("error"):
+        verdict["failures"].append(
+            {"check": "query_ratio", "reason": "query leg failed: "
+             + str(query["error"])})
+    elif ratio is None:
+        verdict["failures"].append(
+            {"check": "query_ratio", "reason": "candidate records no "
+             "query.batch_ratio to hold over the floor"})
+    elif ratio < floor:
+        verdict["failures"].append(
+            {"check": "query_ratio", "candidate": ratio, "floor": floor,
+             "reason": f"query_many({query.get('n_segments')}) answered "
+             f"only {ratio}x faster than single queries (floor {floor})"})
+    return (not verdict["failures"]), verdict
+
+
 def gate_multichip(path: str, min_ratio: float) -> Tuple[bool, dict]:
     """Gate a tools/multichip_bench.py artifact: every leg ran, ratios
     were measured, and no device count fell below ``min_ratio`` x the
@@ -328,6 +358,11 @@ def main(argv=None) -> int:
                         "bucket padding waste (bucketing.adaptive_waste"
                         " from bench.py's before/after pair), e.g. 0.10"
                         " — checked in addition to the median gate")
+    parser.add_argument("--min-query-ratio", type=float, default=None,
+                        metavar="FLOOR",
+                        help="floor on the candidate's batched-query "
+                        "speedup (query.batch_ratio from bench.py's "
+                        "query_many-vs-singles pair), e.g. 5")
     parser.add_argument("--min-fault-ratio", type=float, default=0.4,
                         help="floor for the bigreplay chaos-over-clean "
                         "throughput ratio (default 0.4 — small smoke "
@@ -352,13 +387,14 @@ def main(argv=None) -> int:
             max_shares[stage.strip()] = float(ceil)
         except ValueError:
             parser.error(f"--max-share wants STAGE=CEIL, got {spec!r}")
-    if (max_shares or args.max_padding_waste is not None) \
+    if (max_shares or args.max_padding_waste is not None
+            or args.min_query_ratio is not None) \
             and (args.bigreplay or args.multichip):
         # those artifacts carry no stage shares / bucketing block —
         # refuse loudly rather than silently ignoring a ceiling the
         # caller believes binds
-        parser.error("--max-share/--max-padding-waste apply to "
-                     "--candidate/--self-check runs only")
+        parser.error("--max-share/--max-padding-waste/--min-query-ratio "
+                     "apply to --candidate/--self-check runs only")
 
     if args.bigreplay:
         passed, verdict = gate_bigreplay(args.bigreplay,
@@ -420,6 +456,13 @@ def main(argv=None) -> int:
         verdict["max_padding_waste"] = args.max_padding_waste
         verdict["failures"].extend(pw_verdict["failures"])
         passed = passed and pw_ok
+
+    if args.min_query_ratio is not None:
+        qr_ok, qr_verdict = gate_query_ratio(candidate,
+                                             args.min_query_ratio)
+        verdict["min_query_ratio"] = args.min_query_ratio
+        verdict["failures"].extend(qr_verdict["failures"])
+        passed = passed and qr_ok
 
     verdict["pass"] = passed
     print(json.dumps(verdict, separators=(",", ":")))

@@ -119,7 +119,14 @@ def drive_replay() -> int:
             for p in tr.points)
 
     with tempfile.TemporaryDirectory() as workdir:
+        from reporter_tpu.datastore import BackgroundCompactor
         store = LocalDatastore(os.path.join(workdir, "store"))
+        # the serving-tier thread topology (ISSUE 14): a background
+        # compactor paced fast enough to contend with both writers'
+        # tee ingests on the shared store — its lease/commit paths run
+        # under the witness + perturbation like everything else
+        compactor = BackgroundCompactor(store, max_deltas=1,
+                                        interval_s=0.02).start()
 
         def tee(_tile, segments, ingest_key=None):
             return store.ingest_segments(segments, ingest_key=ingest_key)
@@ -147,6 +154,7 @@ def drive_replay() -> int:
             t.start()
         for t in threads:
             t.join()
+        compactor.stop()
         service.dispatcher.close()
 
         fails = sum(w.parse_failures for w in workers)

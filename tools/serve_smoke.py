@@ -1,0 +1,281 @@
+#!/usr/bin/env python
+"""CI smoke gate for the multi-city serving tier (ISSUE 14).
+
+Two synthetic cities are flushed through REAL streaming workers into
+per-city datastores (worker tee + background compactor + writer lease
+all live), then served from ONE fleet — a single HTTP service whose
+``city=`` requests route through the byte-budgeted residency LRU
+(service/cities.py). Asserted, not just exercised:
+
+- **batched queries**: a ``bbox`` /histogram answer and a repeated-
+  ``segment`` batched answer are BOTH cross-checked segment-for-segment
+  against single ``segment_id`` queries — answer-identical is the
+  contract (datastore/query.py shares one assembler).
+- **lease + compactor surface**: /health carries the store's writer-
+  lease holder view (held by this process) and the compactor's
+  delta-pressure backlog gauge; the background compactor actually
+  compacted (no partition left over pressure).
+- **city LRU + route-memo pre-warm**: a tiny residency budget forces
+  the LRU to evict; the evicted city's route-memo profile (exported
+  from its served traffic) pre-warms the reload, and the reloaded
+  city's FIRST request batch records shared-memo hits > 0 where the
+  cold first load recorded 0 — the cold-start counter pair on
+  /profile. Needs the native runtime; set
+  REPORTER_TPU_CHAOS_REQUIRE_NATIVE=1 (CI does) to fail rather than
+  skip when it is missing.
+"""
+import json
+import os
+import socket
+import sys
+import tempfile
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("REPORTER_TPU_PLATFORM", "cpu")  # CI: never probe
+# one prep worker slot: the per-slot local memo then soaks up every
+# repeat within a process, so SHARED-memo hit counters are a pure
+# signal of the pre-warm (see the cold-start assertion below)
+os.environ.setdefault("REPORTER_TPU_PREP_THREADS", "1")
+
+FMT = ",sv,\\|,0,1,2,3,4"
+
+
+def log(msg: str) -> None:
+    print(f"serve smoke: {msg}", flush=True)
+
+
+def fail(msg: str) -> int:
+    sys.stderr.write(f"serve smoke: FAIL: {msg}\n")
+    return 1
+
+
+def _flush_city(tmp: str, name: str, seed: int, n_traces: int):
+    """One city's produce leg: worker flushes tiles + tees into the
+    city's datastore with the background compactor armed. Returns
+    (graph_path, store_dir, request_jsons)."""
+    import numpy as np
+
+    from reporter_tpu.datastore import BackgroundCompactor, LocalDatastore
+    from reporter_tpu.matcher import SegmentMatcher
+    from reporter_tpu.service.server import ReporterService
+    from reporter_tpu.streaming.anonymiser import Anonymiser, TileSink
+    from reporter_tpu.streaming.formatter import Formatter
+    from reporter_tpu.streaming.worker import StreamWorker, inproc_submitter
+    from reporter_tpu.synth import build_grid_city, generate_trace
+
+    city = build_grid_city(rows=9, cols=9, spacing_m=210.0, seed=seed,
+                           service_road_fraction=0.0,
+                           internal_fraction=0.0)
+    graph = os.path.join(tmp, f"{name}.npz")
+    city.save(graph)
+    store_dir = os.path.join(tmp, f"store-{name}")
+    store = LocalDatastore(store_dir)
+    compactor = BackgroundCompactor(store, max_deltas=2,
+                                    interval_s=0.05)
+    service = ReporterService(SegmentMatcher(net=city), threshold_sec=15,
+                              max_batch=64, max_wait_ms=5.0)
+
+    def tee(_tile, segments, ingest_key=None):
+        return store.ingest_segments(segments, ingest_key=ingest_key)
+
+    rng = np.random.default_rng(seed * 7 + 1)
+    lines, reqs = [], []
+    for i in range(12):
+        tr = None
+        while tr is None:
+            tr = generate_trace(city, f"{name}-veh-{i}", rng,
+                                noise_m=3.0, min_route_edges=8)
+        reqs.append(tr.request_json())
+        for p in tr.points:
+            lines.append("|".join([tr.uuid, str(p["lat"]), str(p["lon"]),
+                                   str(p["time"]), str(p["accuracy"])]))
+    worker = StreamWorker(
+        Formatter.from_config(FMT), inproc_submitter(service),
+        Anonymiser(TileSink(os.path.join(tmp, f"out-{name}")), privacy=1,
+                   quantisation=3600, source=name, tee=tee),
+        reports="0,1,2", transitions="0,1,2",
+        flush_interval_s=1e9, report_flush_interval_s=0.1,
+        submit_many=service.report_many, datastore=store,
+        compactor=compactor)
+    worker.run(lines)
+    service.dispatcher.close()
+    if worker.parse_failures:
+        raise RuntimeError(f"{worker.parse_failures} parse failures")
+    # the background compactor owned compaction (the tee never compacts
+    # inline any more): after the final pass nothing may sit over
+    # pressure
+    left = compactor.pending(refresh=True)
+    if left["partitions_over"]:
+        compactor.run_once()
+        left = compactor.pending()
+    if left["partitions_over"]:
+        raise RuntimeError(f"compactor left pressure behind: {left}")
+    return graph, store_dir, reqs
+
+
+def _get(port: int, path: str) -> dict:
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}",
+                                timeout=60) as r:
+        return json.loads(r.read())
+
+
+def _post(port: int, path: str, body: dict) -> dict:
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=60) as r:
+        return json.loads(r.read())
+
+
+def main() -> int:
+    from reporter_tpu import native
+    from reporter_tpu.datastore import (
+        BackgroundCompactor,
+        LocalDatastore,
+        export_profile,
+    )
+    from reporter_tpu.datastore.profile import profile_path
+    from reporter_tpu.matcher import SegmentMatcher
+    from reporter_tpu.service.cities import CityRegistry
+    from reporter_tpu.service.server import ReporterService, serve
+    from reporter_tpu.utils import metrics
+
+    require_native = bool(
+        os.environ.get("REPORTER_TPU_CHAOS_REQUIRE_NATIVE"))
+    if not native.available() and require_native:
+        return fail("native runtime unavailable but required")
+
+    with tempfile.TemporaryDirectory() as tmp:
+        graphs, stores, reqs = {}, {}, {}
+        for name, seed in (("metro-a", 3), ("metro-b", 17)):
+            graphs[name], stores[name], reqs[name] = _flush_city(
+                tmp, name, seed, 12)
+            log(f"{name}: flushed + tee'd into {stores[name]}")
+
+        # ONE fleet: a tiny residency budget (~one city) so the LRU
+        # must swap; the default stack serves metro-a's store directly
+        from reporter_tpu.graph.network import RoadNetwork
+        registry = CityRegistry(
+            {n: {"graph": graphs[n], "datastore": stores[n]}
+             for n in graphs},
+            budget_bytes=1)  # < one city: strict LRU of exactly 1
+        ds_a = LocalDatastore(stores["metro-a"])
+        service = ReporterService(
+            SegmentMatcher(net=RoadNetwork.load(graphs["metro-a"])),
+            datastore=ds_a, cities=registry)
+        # a REAL gauge, not a zero stub: one refreshed sweep so the
+        # /health assertion below compares against the store's actual
+        # (fully compacted) pressure state
+        service.compactor = BackgroundCompactor(ds_a, max_deltas=2)
+        real_backlog = service.compactor.pending(refresh=True)
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        httpd = serve(service, "127.0.0.1", port)
+        try:
+            # ---- lease + compactor on /health ------------------------
+            health = _get(port, "/health")
+            lease = health["datastore"].get("lease") or {}
+            if not lease.get("enabled"):
+                return fail(f"/health carries no live lease view: "
+                            f"{health['datastore']}")
+            if health.get("compaction") != real_backlog:
+                return fail(f"/health compaction gauge "
+                            f"{health.get('compaction')} != the "
+                            f"refreshed sweep {real_backlog}")
+            if real_backlog["partitions_over"]:
+                return fail(f"worker-leg compactor left pressure: "
+                            f"{real_backlog}")
+
+            # ---- batched queries vs single answers -------------------
+            bbox_body = _get(
+                port, "/histogram?city=metro-a&bbox=-180,-90,180,90"
+                      "&level=2")
+            segs = bbox_body["segments"]
+            if len(segs) < 5 or bbox_body["truncated"]:
+                return fail(f"bbox query implausible: n="
+                            f"{bbox_body['n_segments']} "
+                            f"truncated={bbox_body['truncated']}")
+            ids = [s["segment_id"] for s in segs]
+            for s in segs:
+                single = _get(port, f"/histogram?city=metro-a"
+                                    f"&segment_id={s['segment_id']}")
+                if single != s:
+                    return fail(f"bbox answer differs from single for "
+                                f"{s['segment_id']}")
+            many = _get(port, "/histogram?city=metro-a&"
+                        + "&".join(f"segment={i}" for i in ids[:8]))
+            for got, want_id in zip(many["results"], ids[:8]):
+                single = _get(port, f"/histogram?city=metro-a"
+                                    f"&segment_id={want_id}")
+                if got != single:
+                    return fail(f"query_many answer differs from single "
+                                f"for {want_id}")
+            log(f"batched parity: {len(segs)} bbox segments + "
+                f"{len(ids[:8])} repeated-param segments all equal "
+                f"their single answers")
+
+            # ---- city LRU + memo pre-warm ----------------------------
+            if not native.available():
+                log("native runtime unavailable: memo pre-warm leg "
+                    "SKIPPED")
+                print("serve smoke ok (memo leg skipped)")
+                return 0
+            # cold load of metro-b (evicts metro-a: budget < one city)
+            ev0 = metrics.default.counter("datastore.city.evictions")
+            for r in reqs["metro-b"][:6]:
+                _post(port, "/report", dict(r, city="metro-b"))
+            entry_b = registry.get("metro-b")
+            cold = entry_b.service.matcher.runtime.route_memo_stats()
+            if metrics.default.counter("datastore.city.evictions") <= ev0:
+                return fail("loading metro-b evicted nothing under a "
+                            "1-byte budget")
+            if cold["hits"] != 0:
+                return fail(f"cold-loaded city counted shared-memo hits "
+                            f"without a pre-warm: {cold}")
+            if entry_b.warmed_pairs:
+                return fail("cold load reported warmed pairs with no "
+                            "profile committed")
+            # export metro-b's profile from its served traffic, evict,
+            # reload: the pre-warm must turn the same first batch into
+            # shared-memo hits
+            art = export_profile(entry_b.service.matcher,
+                                 profile_path(stores["metro-b"]),
+                                 city="metro-b")
+            if not art["n_pairs"]:
+                return fail("profile export found no resident pairs")
+            registry.evict("metro-b")
+            for r in reqs["metro-b"][:6]:
+                _post(port, "/report", dict(r, city="metro-b"))
+            prof = _get(port, "/profile")
+            city_view = prof.get("cities", {}).get("resident", {}) \
+                .get("metro-b")
+            if not city_view:
+                return fail(f"/profile carries no metro-b residency "
+                            f"view: {list(prof.get('cities', {}))}")
+            warm = city_view["route_memo"]
+            if not city_view["warmed_pairs"]:
+                return fail("reload did not pre-warm from the profile")
+            if warm["hits"] <= 0:
+                return fail(f"pre-warmed first batch recorded no "
+                            f"shared-memo hits: {warm} (cold: {cold})")
+            log(f"pre-warm: {city_view['warmed_pairs']} pairs warmed, "
+                f"first-batch hits {warm['hits']} (cold load: "
+                f"{cold['hits']})")
+        finally:
+            httpd.shutdown()
+            service.dispatcher.close()
+
+        print(f"serve smoke ok: 2 cities, one fleet; bbox+batched "
+              f"answers identical to singles; LRU swapped under "
+              f"budget; pre-warm hits {warm['hits']} > cold "
+              f"{cold['hits']}")
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -255,6 +255,57 @@ def main() -> int:
     log(f"wire writer storm: 4 threads x 6 rounds over {len(runs)} "
         f"traces, byte parity held")
 
+    # -- leg 7: memo warm/export vs live prep (ISSUE 14) --------------------
+    # The serving tier pre-warms a newly resident city's route memo
+    # from a profile artifact WHILE requests may already be hammering
+    # the same handle: rt_route_memo_warm's bounded Dijkstra + batched
+    # row inserts race rt_prepare_batch's row lookups/inserts and
+    # rt_route_memo_export's whole-stripe walks. Bit-identity of the
+    # prep outputs rides along (a warmed kernel must equal a computed
+    # one), so a logic race TSan misses still fails the leg.
+    wm = SegmentMatcher(net=city, params=MatchParams(max_candidates=8))
+    ea0, eb0 = matcher.runtime.route_memo_export(1 << 16)
+    if ea0.size == 0:
+        return fail("nothing to export from the warmed handle")
+    warm_errors: list = []
+
+    def warm_storm(rounds: int) -> None:
+        try:
+            for _ in range(rounds):
+                wm.runtime.route_memo_warm(ea0, eb0)
+                wm.runtime.route_memo_export(1 << 16)
+        except BaseException as e:
+            warm_errors.append(e)
+
+    def prep_storm(rounds: int) -> None:
+        try:
+            for _ in range(rounds):
+                b = prepare_batch(wm.runtime, traces, wm.params, 64,
+                                  n_threads=4)
+                for k in PREP_KEYS:
+                    if not np.array_equal(np.asarray(b.prep[k]),
+                                          np.asarray(golden[k])):
+                        raise AssertionError(
+                            f"prep key {k} diverged under warm storm")
+        except BaseException as e:
+            warm_errors.append(e)
+
+    wsthreads = ([threading.Thread(target=warm_storm, args=(4,))
+                  for _ in range(2)]
+                 + [threading.Thread(target=prep_storm, args=(3,))
+                    for _ in range(2)])
+    for t in wsthreads:
+        t.start()
+    for t in wsthreads:
+        t.join()
+    if warm_errors:
+        return fail(f"memo warm/export storm: {warm_errors[0]}")
+    wstats = wm.runtime.route_memo_stats()
+    if wstats["size"] <= 0:
+        return fail(f"warm storm left an empty memo ({wstats})")
+    log(f"memo warm/export storm: 2 warmers x 2 preppers over "
+        f"{ea0.size} pairs, prep parity held")
+
     log("clean: all legs passed under the tsan build")
     return 0
 
