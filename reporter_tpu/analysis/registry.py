@@ -77,6 +77,14 @@ ENV_KNOBS: Dict[str, str] = {
     "REPORTER_TPU_LOCKCHECK": "runtime lock witness: 1 arms, raw = A/B leg",
     "REPORTER_TPU_LOCKCHECK_HOLD_MS": "RC002 long-hold threshold (ms)",
     "REPORTER_TPU_RACEFUZZ": "schedule-fuzz spec seed[:prob][@max_us]",
+    "REPORTER_TPU_ADMISSION": "SLO-driven admission gate on /report",
+    "REPORTER_TPU_QUEUE_MAX": "dispatcher queue bound, traces (0 = off)",
+    "REPORTER_TPU_QUEUE_POLICY": "full-queue shed policy: reject|oldest",
+    "REPORTER_TPU_INFLIGHT_MAX": "admitted in-flight cap (0 = derived)",
+    "REPORTER_TPU_BATCH_LATENCY_MS": "per-batch latency budget (0 = fixed)",
+    "REPORTER_TPU_PRESSURE_HOLD_S": "degradation-ladder hysteresis dwell",
+    "REPORTER_TPU_BACKPRESSURE": "streaming offer backpressure (0 = off)",
+    "REPORTER_TPU_BACKPRESSURE_LATENCY_S": "submit-EWMA slow-down threshold",
 }
 
 # ---- metric names ----------------------------------------------------------
@@ -118,6 +126,14 @@ METRICS: Dict[str, str] = {
     "dispatch.traces": "traces dispatched",
     "dispatch.match_many": "batched match call (timer)",
     "dispatch.errors": "dispatch loop errors",
+    # load management (ISSUE 15)
+    "dispatch.queue.*": "bounded-queue sheds: rejected/evicted/waits",
+    "admission.*": "gate verdicts: admitted + shed.{queue,slo,inflight}",
+    "pressure.*": "degradation-ladder transitions + rung effects",
+    "batch.latency.*": "EWMA flush model: per-trace latency + caps",
+    "backpressure.*": "streaming flow control: delays + sheds",
+    "slo.malformed": "malformed SLO specs ignored (fail-open, counted)",
+    "decode.shadow.suppressed": "shadow chunks skipped by the ladder",
     # streaming
     "egress.ok": "tile egress successes",
     "egress.fail": "tile egress failures",
@@ -207,6 +223,7 @@ FAULT_SITES: Dict[str, str] = {
     "worker.offer": "crash at an exact stream position",
     "worker.post_egress": "crash between sink ack and epoch marker",
     "wire.native": "native wire-writer fault -> Python writer, same bytes",
+    "admission.gate": "gate/sensor failure -> fail OPEN (admit), counted",
 }
 
 # ---- durable layout roots --------------------------------------------------

@@ -51,6 +51,18 @@ DEFAULT_SPLIT_WASTE = 0.35
 
 _ladder_cache: "dict[str, tuple]" = {}
 
+#: pressure-ladder rung (service/admission.py "coarse_buckets"): under
+#: sustained overload the adaptive splitter is disabled — fewer, larger
+#: decode shapes, no split dispatches and no fresh compile episodes
+#: mid-storm. The ladder flips it; bucket_ladder() reports threshold
+#: 1.0 (never split) while it holds.
+_pressure_coarse = False
+
+
+def set_pressure_coarse(on: bool) -> None:
+    global _pressure_coarse
+    _pressure_coarse = bool(on)
+
 
 def bucket_ladder() -> "tuple[tuple, float]":
     """(ladder, split_threshold) from REPORTER_TPU_BUCKETS; the default
@@ -61,10 +73,11 @@ def bucket_ladder() -> "tuple[tuple, float]":
     if not spec:
         # the default is NOT cached: LENGTH_BUCKETS is read live, so
         # tests that monkeypatch the module ladder keep working
-        return LENGTH_BUCKETS, DEFAULT_SPLIT_WASTE
+        return (LENGTH_BUCKETS,
+                1.0 if _pressure_coarse else DEFAULT_SPLIT_WASTE)
     got = _ladder_cache.get(spec)
     if got is not None:
-        return got
+        return (got[0], 1.0) if _pressure_coarse else got
     ladder, thresh = LENGTH_BUCKETS, DEFAULT_SPLIT_WASTE
     if spec:
         body, _, tail = spec.partition("@")
@@ -88,7 +101,7 @@ def bucket_ladder() -> "tuple[tuple, float]":
                 ENV_BUCKETS, spec, e)
             ladder, thresh = LENGTH_BUCKETS, DEFAULT_SPLIT_WASTE
     _ladder_cache[spec] = (ladder, thresh)
-    return ladder, thresh
+    return (ladder, 1.0) if _pressure_coarse else (ladder, thresh)
 
 
 def bucket_length(n: int) -> int:

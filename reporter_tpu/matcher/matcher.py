@@ -108,6 +108,18 @@ def _prep_workers() -> int:
                     min(32, os.cpu_count() or 1))
 
 
+#: pressure-ladder last rung (service/admission.py "oracle_decode"):
+#: decode serves via the per-trace numpy oracle — the same degraded
+#: path the decode circuit breaker uses — keeping the device queue
+#: free for the drain backlog. One global load on the hot path.
+_pressure_oracle = False
+
+
+def set_pressure_oracle(on: bool) -> None:
+    global _pressure_oracle
+    _pressure_oracle = bool(on)
+
+
 def pipeline_enabled() -> bool:
     """Overlap the device lanes (decode dispatch; d2h wait + assembly)
     with host prep of later chunks. REPORTER_TPU_PIPELINE forces on/off;
@@ -635,6 +647,12 @@ class SegmentMatcher:
         B, T, K = batch.dist_m.shape
         with metrics.timer("matcher.decode_dispatch"), \
                 profiler.dispatch_span(B, T, K):
+            if _pressure_oracle:
+                # the ladder's last rung: identical results (the oracle
+                # is the breaker's fallback, bit-identical on scan),
+                # device left to the recovery drain
+                metrics.count("pressure.oracle_chunks")
+                return self._decode_numpy_chunk(batch, sigma, beta)
             if not self.circuit_decode.allow():
                 metrics.count("matcher.circuit.decode.fallback_chunks")
                 return self._decode_numpy_chunk(batch, sigma, beta)

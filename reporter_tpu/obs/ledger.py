@@ -177,6 +177,34 @@ def _multichip_entry(source: str, d: dict) -> dict:
             "context": context}
 
 
+def _bigreplay_entry(source: str, d: dict) -> dict:
+    """One ledger entry from a tools/bigreplay.py artifact (the ISSUE
+    15 scaled-probe legs). ``vs_baseline`` holds the chaos-over-clean
+    throughput ratio — a true same-process, same-box ratio like every
+    other entry — and the context carries the oracle agreement and
+    probe scale. Kind ``bigreplay`` is excluded from the bench
+    comparable pool (tools/perf_gate.py ``comparable_pool``), so these
+    ratios never bleed into vs_baseline medians; gate them with
+    ``perf_gate --bigreplay --min-fault-ratio`` instead. Scope follows
+    the probe count: the 100k+ local leg is ``full``, CI-scale runs
+    are ``smoke`` (never cross-compared, same rule as bench)."""
+    probes = d.get("probes") or 0
+    ratio = d.get("fault_throughput_ratio")
+    clean = d.get("clean") or {}
+    return {"source": source,
+            "label": source.replace("BIGREPLAY_", "")
+            .replace(".json", ""),
+            "kind": "bigreplay",
+            "scope": "full" if probes >= 100_000 else "smoke",
+            "platform": "cpu", "decode": None, "pipelined": None,
+            "vs_baseline": ratio,
+            "traces_per_sec": clean.get("probes_per_s"),
+            "baseline_tps": None, "stage_shares": None,
+            "n_devices": None, "ok": bool(ratio),
+            "context": f"probes={probes} agreement={d.get('agreement')}"
+                       f" writers={d.get('writers')}"}
+
+
 def seed_entries(repo: str) -> List[dict]:
     """Normalise every checked-in perf artifact into ledger entries."""
     entries: List[dict] = []
@@ -270,6 +298,14 @@ def seed_entries(repo: str) -> List[dict]:
         with open(path, encoding="utf-8") as f:
             d = json.load(f)
         entries.append(_multichip_entry(os.path.basename(path), d))
+
+    # bigreplay scaled-probe verdicts (ISSUE 15): the chaos/clean
+    # throughput ratio + agreement at production-fidelity scale
+    for path in sorted(glob.glob(os.path.join(repo,
+                                              "BIGREPLAY_r*.json"))):
+        with open(path, encoding="utf-8") as f:
+            d = json.load(f)
+        entries.append(_bigreplay_entry(os.path.basename(path), d))
     return entries
 
 

@@ -92,7 +92,12 @@ _tls = threading.local()  # .active: [compile_calls, compile_s] or None
 
 _listener_registered = False
 _platform_cache: Optional[str] = None
-_queue_depth = 0          # last depth noted by the dispatcher
+#: per-dispatcher queue-depth gauges, keyed by dispatcher name. A
+#: process can run several dispatchers (city stacks, tests); one
+#: last-writer-wins scalar made them overwrite each other, and a
+#: pre-fork child inherited the parent's stale depth — the registry is
+#: cleared by the forksafe hook below so each worker gauges ITS queues
+_queue_depths: Dict[str, int] = {}
 _total_kept = 0           # running occupancy totals (point slots)
 _total_cells = 0
 #: per-bucket-T running [kept, cells] — the recorded waste the adaptive
@@ -105,6 +110,10 @@ _shadow_pending = 0
 _shadow_pool: Optional[ThreadPoolExecutor] = None
 _shadow_sampled = 0
 _shadow_mismatch = 0
+#: pressure-ladder rung (service/admission.py "shed_shadow"): sampling
+#: suspended under sustained overload — the oracle thread's CPU goes
+#: back to serving. Suspensions are counted, never silent.
+_shadow_suspended = False
 
 
 # ---- compile telemetry -----------------------------------------------------
@@ -242,12 +251,36 @@ def dispatch_span(B: int, T: int, K: int) -> _DispatchSpan:
 
 # ---- wide events -----------------------------------------------------------
 
-def note_queue_depth(depth: int) -> None:
-    """Dispatcher backlog after draining a batch — sampled into each
-    wide event as "queue depth at dispatch"."""
-    global _queue_depth
+def note_queue_depth(depth: int, name: str = "dispatch") -> None:
+    """Dispatcher backlog after draining a batch, per NAMED dispatcher
+    — sampled into each wide event as "queue depth at dispatch"."""
     with _lock:
-        _queue_depth = int(depth)
+        _queue_depths[name] = int(depth)
+
+
+def queue_depth(name: Optional[str] = None) -> int:
+    """One dispatcher's last-noted depth, or — with no name — the max
+    across every registered gauge (the wide events' scalar: the worst
+    backlog is the one that matters under pressure)."""
+    with _lock:
+        if name is not None:
+            return _queue_depths.get(name, 0)
+        return max(_queue_depths.values(), default=0)
+
+
+def queue_depths() -> Dict[str, int]:
+    """Every named gauge (the /profile per-dispatcher view)."""
+    with _lock:
+        return dict(_queue_depths)
+
+
+def _reset_queue_depths() -> None:
+    """Forksafe hook: a pre-fork child starts with an empty gauge
+    registry — the parent's dispatcher depths describe queues the
+    child does not own (its own dispatchers re-note after their first
+    drain)."""
+    with _lock:
+        _queue_depths.clear()
 
 
 def chunk_event(bucket_T: int, K: int, traces: int, rows: int,
@@ -283,7 +316,7 @@ def chunk_event(bucket_T: int, K: int, traces: int, rows: int,
         "padded_cells": int(cells),
         "occupancy": round(occupancy, 6),
         "padding_waste": round(waste, 6),
-        "queue_depth": _queue_depth,
+        "queue_depth": queue_depth(),
     }
     if cache:
         event["cache"] = cache
@@ -353,6 +386,15 @@ def shadow_fraction() -> float:
     return max(0.0, _env_float(ENV_SHADOW, 0.0))
 
 
+def set_shadow_suspended(on: bool) -> None:
+    """Pressure-ladder rung (service/admission.py): suspend / resume
+    shadow-accuracy sampling. Under the lock only for write-discipline
+    consistency with reset(); readers take one global load."""
+    global _shadow_suspended
+    with _lock:
+        _shadow_suspended = bool(on)
+
+
 def _ensure_shadow_pool() -> ThreadPoolExecutor:
     global _shadow_pool
     with _lock:
@@ -370,6 +412,12 @@ def maybe_shadow(batch, decoded: np.ndarray, n_real: int,
     shed (counted) rather than queued without bound."""
     frac = shadow_fraction()
     if frac <= 0.0 or n_real <= 0:
+        return
+    if _shadow_suspended:
+        # the shed_shadow pressure rung: sampling paused, accounted —
+        # the accumulator does not advance, so easing pressure resumes
+        # the configured cadence, not a burst of catch-up chunks
+        metrics.count("decode.shadow.suppressed")
         return
     global _shadow_acc, _shadow_pending
     with _lock:
@@ -481,7 +529,8 @@ def shadow_stats() -> dict:
         return {"fraction": shadow_fraction(),
                 "sampled": _shadow_sampled,
                 "mismatch": _shadow_mismatch,
-                "pending": _shadow_pending}
+                "pending": _shadow_pending,
+                "suspended": _shadow_suspended}
 
 
 def shadow_mismatches() -> int:
@@ -546,7 +595,7 @@ def snapshot(n_events: int = 64) -> dict:
     with _lock:
         raw = [dict(st) for st in _shapes.values()]
         kept, cells = _total_kept, _total_cells
-        depth = _queue_depth
+        depths = dict(_queue_depths)
         episodes = _compile_episodes
     shapes = [_shape_view(st) for st in raw]
     shapes.sort(key=lambda s: (s["T"], s["K"], s["B"]))
@@ -560,20 +609,22 @@ def snapshot(n_events: int = 64) -> dict:
             "padding_waste": round(1.0 - kept / cells, 6) if cells
             else None},
         "shadow": shadow_stats(),
-        "queue_depth": depth,
+        "queue_depth": max(depths.values(), default=0),
+        "queue_depths": depths,
     }
 
 
 def reset() -> None:
     """Drop every table/ring/total (tests). Re-reads the ring-size env
     so a test can shrink the ring."""
-    global _queue_depth, _total_kept, _total_cells, _compile_episodes, \
+    global _total_kept, _total_cells, _compile_episodes, \
         _shadow_acc, _shadow_pending, _shadow_sampled, _shadow_mismatch, \
-        _events
+        _shadow_suspended, _events
     with _lock:
         _shapes.clear()
         _bucket_totals.clear()
-        _queue_depth = 0
+        _queue_depths.clear()
+        _shadow_suspended = False
         _total_kept = 0
         _total_cells = 0
         _compile_episodes = 0
@@ -584,3 +635,11 @@ def reset() -> None:
         _events = _locks.Guarded(
             collections.deque(maxlen=max(16, _env_int(ENV_RING, 512))),
             _lock, "profiler.events")
+
+
+# fork safety: a pre-fork child must never inherit the parent's
+# dispatcher queue-depth gauges (they describe queues the child does
+# not own; its own dispatchers re-note after their first drain)
+from ..utils import forksafe as _forksafe  # noqa: E402
+
+_forksafe.register(_reset_queue_depths)

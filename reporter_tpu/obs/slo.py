@@ -64,6 +64,10 @@ def thresholds() -> Dict[str, float]:
     try:
         parsed = parse_spec(spec) if spec else {}
     except ValueError as e:
+        # fail open AND counted: once per new spec value (this branch
+        # is the cache-miss path), so the warning is visible on
+        # /metrics without a health probe inflating it per call
+        metrics.count("slo.malformed")
         logger.error("ignoring malformed %s=%r: %s", ENV_VAR, spec, e)
         parsed = {}
     _cache_spec, _cache_parsed = spec, parsed

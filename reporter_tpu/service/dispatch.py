@@ -12,6 +12,8 @@ This is the micro-batch buffer SURVEY.md §2.4 calls the north-star addition.
 """
 from __future__ import annotations
 
+import itertools
+import os
 import queue
 import threading
 import time
@@ -22,6 +24,25 @@ from ..obs import profiler
 from ..obs import trace as obs_trace
 from ..utils import locks as _locks
 from ..utils import metrics
+from .admission import Overload, retry_after_s
+
+#: dispatcher queue bound in traces (0 = unbounded, the pre-ISSUE-15
+#: behaviour). Bounded by default: an unbounded queue under overload is
+#: latency debt every later request pays — better to say no at the door
+ENV_QUEUE_MAX = "REPORTER_TPU_QUEUE_MAX"
+DEFAULT_QUEUE_MAX = 4096
+#: what happens when the bounded queue is full: "reject" sheds the NEW
+#: submit (Overload -> HTTP 429 upstream), "oldest" sheds the oldest
+#: queued slot to make room (its waiter gets the Overload — freshest
+#: work wins). Both are counted; nothing is ever dropped silently.
+ENV_QUEUE_POLICY = "REPORTER_TPU_QUEUE_POLICY"
+#: per-batch latency budget in ms driving the EWMA flush model
+#: (0 = fixed count/interval flushing, the pre-ISSUE-15 behaviour)
+ENV_BATCH_LATENCY = "REPORTER_TPU_BATCH_LATENCY_MS"
+#: EWMA smoothing for the per-trace service-time model
+_EWMA_ALPHA = 0.2
+
+_dispatcher_seq = itertools.count(1)
 
 #: queue sentinel close() enqueues AFTER the closed flag flips: every
 #: real slot precedes it, so the loop drains all in-flight work, then
@@ -59,7 +80,12 @@ class BatchDispatcher:
 
     def __init__(self, match_many: Callable[[Sequence[dict]], List[dict]],
                  max_batch: int = 256, max_wait_ms: float = 20.0,
-                 idle_grace_ms: float = 2.0):
+                 idle_grace_ms: float = 2.0,
+                 queue_max: Optional[int] = None,
+                 queue_policy: Optional[str] = None,
+                 latency_budget_ms: Optional[float] = None,
+                 name: Optional[str] = None):
+        from ..utils.runtime import _env_float, _env_int
         self._match_many = match_many
         self.max_batch = max_batch
         self.max_wait = max_wait_ms / 1000.0
@@ -69,13 +95,72 @@ class BatchDispatcher:
         # latency without adding batch — max_wait stays the hard bound
         # for a steady trickle of arrivals
         self.idle_grace = min(idle_grace_ms / 1000.0, self.max_wait)
-        self._queue: "queue.Queue[_Slot]" = queue.Queue()
+        # named so the per-dispatcher queue-depth gauges (profiler) and
+        # a multi-dispatcher process (city stacks) stay distinguishable
+        self.name = name or f"dispatch{next(_dispatcher_seq)}"
+        # bounded queue (ISSUE 15): full sheds loudly instead of
+        # growing latency debt without bound; 0 keeps it unbounded
+        self.queue_max = queue_max if queue_max is not None \
+            else _env_int(ENV_QUEUE_MAX, DEFAULT_QUEUE_MAX)
+        self.queue_policy = (queue_policy
+                             or os.environ.get(ENV_QUEUE_POLICY,
+                                               "reject")).strip().lower()
+        if self.queue_policy not in ("reject", "oldest"):
+            self.queue_policy = "reject"
+        self._queue: "queue.Queue[_Slot]" = queue.Queue(
+            maxsize=max(0, self.queue_max))
+        # latency-targeted micro-batching: an EWMA of per-trace service
+        # time turns the flush decision into "how many traces fit the
+        # REPORTER_TPU_BATCH_LATENCY_MS budget" — batch size shrinks
+        # under load (service time inflates) and grows back when idle.
+        # 0 disables: fixed max_batch/max_wait flushing.
+        self.latency_budget = (latency_budget_ms
+                               if latency_budget_ms is not None
+                               else _env_float(ENV_BATCH_LATENCY,
+                                               0.0)) / 1000.0
+        # written only by the dispatch loop thread; read cross-thread
+        # by the admission gate (a torn read of a float cannot happen
+        # in CPython, and the gate only wants an estimate)
+        self._ewma_per_trace: Optional[float] = None
+        # traces in the batch currently being matched: queue_depth()
+        # includes them — a drained-but-in-service batch is wait a new
+        # arrival pays just like queued slots, and hiding it from the
+        # gate's deadline check under-predicts by a whole batch wall
+        self._in_service = 0
         self._batches = 0  # batch sequence, stamped on batch spans
         self._closed = False
         self._stopping = False  # loop consumed the _STOP sentinel
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="match-dispatch")
         self._thread.start()
+
+    # ---- load-management sensors ----------------------------------------
+    def queue_depth(self) -> int:
+        """Live backlog in traces — queued slots PLUS the batch in
+        service (the admission gate's DEADLINE sensor: both are wait a
+        new arrival pays before its own batch dispatches)."""
+        return self._queue.qsize() + self._in_service
+
+    def queued_depth(self) -> int:
+        """Queued slots only — the gate's HARD-BOUND sensor. The batch
+        in service must not count against ``queue_max`` (a max_batch
+        larger than the bound would read as permanently full and shed
+        everything for every batch wall)."""
+        return self._queue.qsize()
+
+    def service_ewma_s(self) -> Optional[float]:
+        """EWMA per-trace service time (None before the first batch)."""
+        return self._ewma_per_trace
+
+    def _effective_cap(self) -> int:
+        """Traces the latency budget allows per batch: min(max_batch,
+        budget / per-trace EWMA), floored at 1 so the dispatcher always
+        makes progress even when one trace alone busts the budget."""
+        if self.latency_budget <= 0.0 or not self._ewma_per_trace:
+            return self.max_batch
+        return max(1, min(self.max_batch,
+                          int(self.latency_budget
+                              / self._ewma_per_trace)))
 
     # ---- request side ----------------------------------------------------
     def submit(self, trace: dict, timeout: float = 60.0,
@@ -87,7 +172,7 @@ class BatchDispatcher:
             raise RuntimeError("dispatcher is closed")
         slot = _Slot(trace, columns)
         _locks.fuzz_point("dispatch.queue.put")
-        self._queue.put(slot)
+        self._enqueue_nowait(slot)
         if not slot.event.wait(timeout):
             raise TimeoutError("match result not ready in time")
         if slot.error is not None:
@@ -125,8 +210,12 @@ class BatchDispatcher:
             slots = [_Slot(tr) for tr in traces]
         for slot in slots:  # enqueue ALL before waiting on any
             _locks.fuzz_point("dispatch.queue.put")
-            self._queue.put(slot)
-        n_batches = max(1, -(-len(slots) // self.max_batch))
+            self._enqueue_blocking(slot, timeout)
+        # deadline scales with the batches the list will ACTUALLY need:
+        # under a latency budget the drain loop flushes at the EWMA-
+        # shrunk cap, not max_batch — sizing by max_batch would time
+        # out large streaming flushes exactly when the model kicks in
+        n_batches = max(1, -(-len(slots) // self._effective_cap()))
         deadline = time.monotonic() + timeout * n_batches
         results: List = []
         for slot in slots:
@@ -145,6 +234,55 @@ class BatchDispatcher:
             results.append(slot.result)
         return results
 
+    # ---- bounded enqueue -------------------------------------------------
+    def _overload(self) -> Overload:
+        return Overload("queue", retry_after_s(self._queue.qsize(),
+                                               self._ewma_per_trace))
+
+    def _enqueue_nowait(self, slot: _Slot) -> None:
+        """The request-path enqueue: a full bounded queue sheds — the
+        NEW slot under the "reject" policy, the OLDEST queued slot
+        under "oldest" (freshest work wins; the displaced waiter gets
+        the Overload). Every shed is counted; nothing silent."""
+        while True:
+            try:
+                self._queue.put_nowait(slot)
+                return
+            except queue.Full:
+                pass
+            if self.queue_policy != "oldest":
+                metrics.count("dispatch.queue.rejected")
+                raise self._overload()
+            try:
+                old = self._queue.get_nowait()
+            except queue.Empty:
+                continue  # the loop drained it first — retry the put
+            if old is _STOP:
+                # close() raced us: restore the sentinel, refuse ours
+                self._queue.put(old)
+                metrics.count("dispatch.queue.rejected")
+                raise self._overload()
+            old.error = self._overload()
+            old.event.set()
+            metrics.count("dispatch.queue.evicted")
+
+    def _enqueue_blocking(self, slot: _Slot, timeout: float) -> None:
+        """The streaming-flush enqueue: a full queue BLOCKS (bounded by
+        ``timeout``) — this is the end-to-end backpressure, the queue
+        bound propagating to the producer instead of shedding its
+        flush. A wait that times out raises Overload; the batcher's
+        requeue/dead-letter budget absorbs it."""
+        try:
+            self._queue.put_nowait(slot)
+            return
+        except queue.Full:
+            metrics.count("dispatch.queue.waits")
+        try:
+            self._queue.put(slot, timeout=timeout)
+        except queue.Full:
+            metrics.count("dispatch.queue.rejected")
+            raise self._overload() from None
+
     # ---- dispatch loop ---------------------------------------------------
     # the drain loop is single-thread-owned (the match-dispatch thread);
     # @thread_affine turns a second thread draining the queue — exactly
@@ -153,17 +291,23 @@ class BatchDispatcher:
     @_locks.thread_affine
     def _drain_batch(self) -> List[_Slot]:
         """Block for the first trace, then collect until a flush
-        condition: ``max_batch`` reached, ``max_wait`` elapsed since the
-        first trace, the queue stayed empty for ``idle_grace``, or the
-        close() sentinel surfaced (every slot before it still flushes)."""
+        condition: the effective batch cap reached (``max_batch``, or
+        fewer when the latency budget's EWMA model says a full batch
+        would bust ``REPORTER_TPU_BATCH_LATENCY_MS``), ``max_wait``
+        elapsed since the first trace, the queue stayed empty for
+        ``idle_grace``, or the close() sentinel surfaced (every slot
+        before it still flushes)."""
         _locks.fuzz_point("dispatch.queue.get")
         first = self._queue.get()
         if first is _STOP:
             self._stopping = True
             return []
         slots = [first]
+        cap = self._effective_cap()
+        if cap < self.max_batch:
+            metrics.count("batch.latency.capped_batches")
         t0 = time.monotonic()
-        while len(slots) < self.max_batch:
+        while len(slots) < cap:
             remaining = self.max_wait - (time.monotonic() - t0)
             if remaining <= 0:
                 break
@@ -188,8 +332,11 @@ class BatchDispatcher:
             metrics.count("dispatch.batches")
             metrics.count("dispatch.traces", len(slots))
             # backlog left behind after this drain — "queue depth at
-            # dispatch" stamped into the profiler's wide events
-            profiler.note_queue_depth(self._queue.qsize())
+            # dispatch" stamped into the profiler's wide events, under
+            # THIS dispatcher's name (a pre-fork child resets the gauge
+            # registry, so it never inherits the parent's stale depth)
+            profiler.note_queue_depth(self._queue.qsize(),
+                                      name=self.name)
             # adopt one submitter's trace context so the batch's stage
             # spans parent to that request (a merged batch can only
             # follow one requester; the batch attrs record the merge)
@@ -198,6 +345,7 @@ class BatchDispatcher:
                 if s.ctx is not None:
                     ctx = s.ctx
                     break
+            self._in_service = len(slots)
             try:
                 with obs_trace.attach(ctx), \
                         obs_trace.span("dispatch.batch",
@@ -212,8 +360,11 @@ class BatchDispatcher:
                             [s.columns for s in slots])
                     else:
                         batch = [s.trace for s in slots]
+                    t_match = time.monotonic()
                     with metrics.timer("dispatch.match_many"):
                         results = self._match_many(batch)
+                    self._note_service_time(
+                        time.monotonic() - t_match, len(slots))
                     for slot, res in zip(slots, results):
                         slot.result = res
             except Exception as e:  # propagate to every waiter in the batch
@@ -221,8 +372,23 @@ class BatchDispatcher:
                 for slot in slots:
                     slot.error = e
             finally:
+                self._in_service = 0
                 for slot in slots:
                     slot.event.set()
+
+    def _note_service_time(self, elapsed_s: float, n: int) -> None:
+        """Feed one batch's wall into the per-trace EWMA service-time
+        model (dispatch-loop thread only). The EWMA drives both the
+        latency-budget flush cap and the gate's Retry-After estimate."""
+        if n <= 0:
+            return
+        per_trace = elapsed_s / n
+        prev = self._ewma_per_trace
+        self._ewma_per_trace = per_trace if prev is None else \
+            (1.0 - _EWMA_ALPHA) * prev + _EWMA_ALPHA * per_trace
+        metrics.observe("batch.latency.per_trace", per_trace)
+        if self.latency_budget > 0.0 and elapsed_s > self.latency_budget:
+            metrics.count("batch.latency.over_budget")
 
     def close(self, timeout: float = 30.0) -> bool:
         """Shut down by DRAINING, not abandoning: refuse new submits,

@@ -33,6 +33,7 @@ from ..core.tracebatch import points_to_columns
 from ..matcher import Configure, SegmentMatcher
 from ..obs import trace as obs_trace
 from ..utils import metrics
+from . import admission
 from .dispatch import BatchDispatcher
 from .report import report, report_wire
 
@@ -90,6 +91,14 @@ class ReporterService:
         # tests and the chaos harness can see which worker answered;
         # None (single-process mode) adds no header
         self.proc_tag: str | None = None
+        # SLO-driven admission control (service/admission.py, ISSUE 15):
+        # armed by REPORTER_TPU_ADMISSION — the /report front door sheds
+        # with 429 + Retry-After before work is queued, and feeds the
+        # process-wide pressure ladder. None = admit everything (the
+        # pre-ISSUE-15 behaviour; the bounded dispatcher queue is still
+        # the loud backstop).
+        self.admission = admission.AdmissionGate(self.dispatcher) \
+            if admission.armed() else None
 
     def handle(self, trace: dict) -> "tuple[int, str | bytes | memoryview]":
         """Validate + match + report; (status, body). The 200 body is
@@ -131,6 +140,14 @@ class ReporterService:
             with obs_trace.span("report.serialise"):
                 return 200, report_wire(match, trace, self.threshold_sec,
                                         report_levels, transition_levels)
+        except admission.Overload as e:
+            # the bounded dispatcher queue shed this request (the
+            # backstop behind the admission gate): 429, with the
+            # computed back-off in the body — the HTTP handler lifts
+            # it into the Retry-After header
+            return 429, json.dumps({"error": "overloaded",
+                                    "reason": e.reason,
+                                    "retry_after_s": e.retry_after_s})
         except Exception as e:
             return 500, json.dumps({"error": str(e)})
 
@@ -158,6 +175,25 @@ class ReporterService:
             return 500, json.dumps({"error": f"city load failed: {e}"})
         try:
             sub = {k: v for k, v in req.items() if k != "city"}
+            # the routed city's OWN admission gate guards its /report
+            # path: the front-door gate only watches THIS service's
+            # dispatcher, and a city stack's bounded queue filling up
+            # must shed city traffic — not ride on the default stack's
+            # idle sensors. (The city key lives in the parsed body, so
+            # city sheds are necessarily post-parse; they still happen
+            # before any work is queued on the city's dispatcher.)
+            gate = getattr(entry.service, "admission", None) \
+                if method == "handle" else None
+            if gate is not None:
+                shed = gate.admit()
+                if shed is not None:
+                    return 429, json.dumps(
+                        {"error": "overloaded", "reason": shed.reason,
+                         "retry_after_s": shed.retry_after_s})
+                try:
+                    return entry.service.handle(sub)
+                finally:
+                    gate.release()
             return getattr(entry.service, method)(sub)
         finally:
             self.cities.release(entry)
@@ -252,6 +288,18 @@ class ReporterService:
             # REPORTER_TPU_SLO_MS to make a mismatch rate flip 503)
             "shadow": profiler.shadow_stats(),
         }
+        # load-management view (ISSUE 15): the degradation-ladder state
+        # plus — when the gate is armed — its live sensors and per-
+        # reason shed counters. Informational: a shedding service is
+        # doing its job, not failing; the ladder's rungs each have
+        # their own degraded signals above. health() doubles as the
+        # idle-period ladder tick so a service that stopped receiving
+        # traffic still steps back up.
+        if self.admission is not None:
+            self.admission.tick()
+        body["pressure"] = admission.pressure_snapshot()
+        body["admission"] = self.admission.snapshot() \
+            if self.admission is not None else {"armed": False}
         healthy = True
         if open_domains:
             healthy = False
@@ -337,7 +385,8 @@ def make_handler(service: ReporterService):
             raise ValueError("No json provided")
 
         def _respond(self, code: int, body,
-                     content_type: str = "application/json;charset=utf-8"):
+                     content_type: str = "application/json;charset=utf-8",
+                     headers=None):
             # str bodies encode here; bytes/memoryview bodies (the
             # native wire writer's buffer) go to the socket AS IS —
             # the zero-copy handoff the C writer exists for
@@ -349,10 +398,29 @@ def make_handler(service: ReporterService):
             self.send_header("Access-Control-Allow-Origin", "*")
             if service.proc_tag is not None:
                 self.send_header("X-Reporter-Proc", service.proc_tag)
+            for key, value in (headers or {}).items():
+                self.send_header(key, value)
             self.send_header("Content-type", content_type)
             self.send_header("Content-length", str(len(raw)))
             self.end_headers()
             self.wfile.write(raw)
+
+        def _respond_shed(self, code: int, body, retry_after_s=None):
+            """A load-shed response: every 429 carries the computed
+            ``Retry-After`` — the contract utils/http.py clients
+            already honour. Callers that hold the Overload pass the
+            seconds directly (the front-door shed path is HOT under
+            overload); only bodies built deeper in the stack (the
+            dispatcher backstop, a routed city's gate) pay the parse."""
+            retry = retry_after_s
+            if retry is None:
+                try:
+                    retry = json.loads(body).get("retry_after_s")
+                except Exception:
+                    pass
+            headers = {"Retry-After": str(int(retry))} \
+                if retry is not None else None
+            self._respond(code, body, headers=headers)
 
         def _parse_histogram(self, post: bool) -> dict:
             """Histogram params: JSON body / ``json=`` like /report, or
@@ -405,6 +473,12 @@ def make_handler(service: ReporterService):
             if action == "profile":
                 from ..obs import profiler
                 prof = profiler.snapshot()
+                # the load-management view rides /profile too: sheds
+                # per reason, in-flight, per-dispatcher queue gauges
+                # (prof["queue_depths"]) and the ladder state
+                prof["pressure"] = admission.pressure_snapshot()
+                if service.admission is not None:
+                    prof["admission"] = service.admission.snapshot()
                 if service.cities is not None:
                     # the residency table with each city's route-memo
                     # counters + warmed_pairs: the cold-start pair a
@@ -432,13 +506,34 @@ def make_handler(service: ReporterService):
                     metrics.count(f"service.errors.{code}")
                 self._respond(code, body)
                 return
+            # the admission gate (ISSUE 15): shed BEFORE the body is
+            # even parsed — a 429 must cost headers, not work. The
+            # in-flight slot an admit holds is released when the
+            # response is written, whatever its status.
+            gate = service.admission
+            if gate is not None:
+                shed = gate.admit()
+                if shed is not None:
+                    metrics.count("service.errors.429")
+                    self._respond_shed(
+                        429, json.dumps(
+                            {"error": "overloaded",
+                             "reason": shed.reason,
+                             "retry_after_s": shed.retry_after_s}),
+                        retry_after_s=shed.retry_after_s)
+                    return
             # ?trace=1 debug flag: arm tracing for this request and ship
             # the request's span tree (Chrome/Perfetto trace-event JSON)
-            # alongside the report body
+            # alongside the report body. The pressure ladder's
+            # shed_trace rung refuses the flag under sustained overload
+            # (the report still serves — only the debug tree is shed).
             qs = urllib.parse.parse_qs(split.query)
             # same falsy spellings as REPORTER_TPU_TRACE env parsing
             want_trace = qs.get("trace", ["0"])[0].lower() \
                 not in ("", "0", "off", "false")
+            if want_trace and not admission.allow_request_trace():
+                metrics.count("pressure.trace_suppressed")
+                want_trace = False
             if want_trace:
                 obs_trace.force_begin()
             try:
@@ -464,9 +559,14 @@ def make_handler(service: ReporterService):
             finally:
                 if want_trace:
                     obs_trace.force_end()
+                if gate is not None:
+                    gate.release()
             if code != 200:
                 metrics.count(f"service.errors.{code}")
-            self._respond(code, body)
+            if code == 429:
+                self._respond_shed(code, body)
+            else:
+                self._respond(code, body)
 
         def do_GET(self):
             self._do(False)

@@ -36,6 +36,8 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+import time
+
 from ..core.geo import equirectangular_m
 from ..core.osmlr import INVALID_SEGMENT_ID
 from ..core.tracebatch import TraceBatch, TraceView
@@ -43,6 +45,7 @@ from ..core.types import Point, Segment
 from ..obs import flightrec
 from ..obs import trace as obs_trace
 from ..utils import faults, metrics, spool
+from .backpressure import BackpressureGovernor
 
 logger = logging.getLogger("reporter_tpu.streaming")
 
@@ -221,7 +224,8 @@ class PointBatcher:
                      [List[dict]], List[Optional[dict]]]] = None,
                  report_flush: int = 64,
                  retry_budget: Optional[int] = None,
-                 deadletter_dir: Optional[str] = None):
+                 deadletter_dir: Optional[str] = None,
+                 governor: Optional[BackpressureGovernor] = None):
         self.submit = submit
         # batched submit for flush paths (one device batch for a whole
         # punctuate/pending flush); falls back to per-uuid submit
@@ -259,6 +263,21 @@ class PointBatcher:
         # files replay by POSTing their body to any /report endpoint
         self.deadletter_dir = deadletter_dir
         self._deadletter_seq = 0
+        # backpressure governor (streaming/backpressure.py): submit-
+        # latency EWMA + requeue depth -> bounded offer delays and,
+        # past the shed threshold, report-ready sessions dead-letter
+        # instead of joining a pending set that can only grow
+        self.governor = governor if governor is not None \
+            else BackpressureGovernor()
+        # sessions currently carrying a failed-submit retry — the
+        # governor's requeue-depth sensor, maintained O(1) here instead
+        # of scanned O(store) per flush
+        self._retrying: Dict[str, None] = {}
+
+    def offer_delay(self) -> float:
+        """The governor's current per-offer block (the worker's offer
+        loop sleeps this before accepting the next message)."""
+        return self.governor.offer_delay()
 
     def _submit_safe(self, body) -> Optional[dict]:
         if isinstance(body, TraceView):
@@ -284,6 +303,18 @@ class PointBatcher:
         else:
             batch.update(point)
             if batch.should_report(REPORT_DIST, REPORT_COUNT, REPORT_TIME):
+                if self.governor.should_shed():
+                    # backpressure past the shed threshold: the matcher
+                    # cannot keep up, so a report-ready session dead-
+                    # letters its trace JSON NOW (replayable, bounded
+                    # by the spool cap) instead of joining a pending
+                    # set that can only grow while submits fail
+                    metrics.count("backpressure.shed")
+                    self._retrying.pop(uuid, None)
+                    self._deadletter(uuid, batch)
+                    batch.drop()
+                    batch.retries = 0
+                    return
                 # defer to the next batched flush instead of matching
                 # this one session at batch=1 (the reference's only mode)
                 self.pending[uuid] = None
@@ -308,6 +339,7 @@ class PointBatcher:
             tb = TraceBatch.concat([
                 batch.request_columns(uuid, self.options)
                 for uuid, batch in due])
+            t0 = time.monotonic()
             try:
                 faults.failpoint("matcher.submit")
                 responses = self.submit_many(tb)
@@ -315,12 +347,20 @@ class PointBatcher:
                 logger.error("batched submit failed for %d traces: %s",
                              len(due), e)
                 responses = [None] * len(due)
+            elapsed = time.monotonic() - t0
+            failures = 0
             for (uuid, batch), response in zip(due, responses):
                 if response is None:
+                    failures += 1
                     self._submit_failed(uuid, batch)
                     continue
                 batch.retries = 0
+                self._retrying.pop(uuid, None)
                 self._forward_all(batch.apply_response(uuid, response))
+            # feed the backpressure sensors AFTER the retry bookkeeping
+            # so the requeue depth reflects this flush's outcome
+            self.governor.note_flush(len(due), elapsed, failures,
+                                     len(self._retrying))
 
     def _submit_failed(self, uuid: str, batch: Batch) -> None:
         """One failed round trip: requeue a live batch under the budget,
@@ -330,11 +370,13 @@ class PointBatcher:
                 and batch.retries < self.retry_budget:
             batch.retries += 1
             self.pending[uuid] = None
+            self._retrying[uuid] = None
             metrics.count("batch.requeued")
             logger.warning("submit failed for %s; requeued (%d/%d)",
                            uuid, batch.retries, self.retry_budget)
             return
         metrics.count("batch.dropped")
+        self._retrying.pop(uuid, None)
         self._deadletter(uuid, batch)
         batch.drop()
         # the budget is per report attempt: a session that re-qualifies
@@ -402,6 +444,10 @@ class PointBatcher:
             if stream_time_ms - batch.last_update > self.session_gap_ms:
                 del self.store[uuid]
                 self.pending.pop(uuid, None)
+                # an evicted session leaves the requeue-depth sensor
+                # (its dead-letter path re-accounts it if the final
+                # report fails too)
+                self._retrying.pop(uuid, None)
                 if batch.should_report(0, 2, 0):
                     due.append((uuid, batch))
         for uuid in self.pending:  # still live, thresholds crossed

@@ -44,6 +44,7 @@ import itertools
 import json
 import logging
 import os
+import random
 import time
 from typing import Callable, Dict, List, Optional, Tuple
 
@@ -85,6 +86,8 @@ class DeadLetterDrainer:
                  max_attempts: int = 5,
                  base_backoff_s: float = 1.0,
                  max_backoff_s: float = 60.0,
+                 backoff_jitter: float = 0.25,
+                 jitter_seed: Optional[int] = None,
                  clock: Callable[[], float] = time.monotonic):
         self.tile_root = tile_root
         if trace_root is None and tile_root:
@@ -102,6 +105,17 @@ class DeadLetterDrainer:
         self.max_attempts = max(1, int(max_attempts))
         self.base_backoff_s = base_backoff_s
         self.max_backoff_s = max_backoff_s
+        # seeded jitter on the capped exponential backoff: a fleet of
+        # workers recovering from ONE outage all hit the same capped
+        # schedule, so without jitter every re-submit lands in
+        # thundering-herd lockstep against the sink that just came
+        # back. Each entry's delay stretches by a uniform draw in
+        # [0, backoff_jitter]; the RNG seeds from the pid by default
+        # (distinct per fleet member) and from ``jitter_seed`` in tests
+        # — the whole schedule is then deterministic by seed.
+        self.backoff_jitter = max(0.0, float(backoff_jitter))
+        self._jitter_rng = random.Random(
+            os.getpid() if jitter_seed is None else jitter_seed)
         self.clock = clock
         self._next_pass = clock()
         # budget key -> consecutive failed attempts; entries leave the
@@ -269,9 +283,12 @@ class DeadLetterDrainer:
             self._quarantine(root, path)
             return False
         self._attempts[key] = attempts
-        self._due[key] = now + min(
-            self.base_backoff_s * (2.0 ** (attempts - 1)),
-            self.max_backoff_s)
+        backoff = min(self.base_backoff_s * (2.0 ** (attempts - 1)),
+                      self.max_backoff_s)
+        # jitter AFTER the cap: capped entries are exactly the ones a
+        # whole recovering fleet would otherwise retry in lockstep
+        backoff *= 1.0 + self.backoff_jitter * self._jitter_rng.random()
+        self._due[key] = now + backoff
         return False
 
     #: replay attempts one paced pass may spend: maybe_drain runs on the

@@ -43,6 +43,17 @@ from .formatter import Formatter
 logger = logging.getLogger("reporter_tpu.streaming")
 
 
+def _pressure_level() -> dict:
+    """The process-wide degradation-ladder state for the heartbeat
+    (lazy import: a pure-streaming process without the serving tier
+    loaded reports the quiescent shape without importing it)."""
+    import sys
+    admission = sys.modules.get("reporter_tpu.service.admission")
+    if admission is None:
+        return {"level": 0, "state": "normal", "transitions": 0}
+    return admission.pressure_snapshot()
+
+
 def http_submitter(url: str) -> Callable[[dict], Optional[dict]]:
     """POST the trace to a matcher service, with the reference's retry
     policy; returns parsed JSON or None (reference: HttpClient.java:65-103).
@@ -216,6 +227,16 @@ class StreamWorker:
             if self.processed % 10000 == 0:
                 logger.info("Processed %d messages", self.processed)
             self.maybe_punctuate()
+            # streaming backpressure (ISSUE 15): when the submit-latency
+            # EWMA or requeue depth crosses its threshold, the offer
+            # loop BLOCKS (bounded) before the next message — the
+            # slowdown propagates upstream instead of memory absorbing
+            # it. Real wall sleep on purpose: injected replay clocks
+            # must not defeat flow control.
+            delay = self.batcher.offer_delay()
+            if delay > 0.0:
+                metrics.count("backpressure.delays")
+                time.sleep(delay)
 
     def maybe_punctuate(self, force: bool = False) -> None:
         now = self.clock()
@@ -298,6 +319,12 @@ class StreamWorker:
             else None,
             "compile_count": profiler.compile_count(),
             "shadow_mismatches": profiler.shadow_mismatches(),
+            # load management (ISSUE 15): the process-wide degradation-
+            # ladder state and this worker's backpressure sensors — a
+            # pressured fleet is visible in the heartbeat stream long
+            # before a dashboard is opened
+            "pressure": _pressure_level(),
+            "backpressure": self.batcher.governor.snapshot(),
         }, separators=(",", ":")))
 
     def _flush_tiles(self) -> None:

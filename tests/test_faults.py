@@ -257,6 +257,41 @@ class TestHealthAction:
 class TestFailpointSites:
     """The named sites actually sit where the docs say they sit."""
 
+    def test_admission_gate_failpoint_fails_open(self):
+        """admission.gate sits at the TOP of the gate's admit path, and
+        a fault there fails OPEN: the request is admitted (a broken
+        sensor must degrade to serve-everything, never to
+        shed-everything) and the failure is counted."""
+        from reporter_tpu.service import admission
+        from reporter_tpu.service.admission import AdmissionGate
+
+        class Stub:
+            queue_max = 0
+            max_batch = 8
+
+            def queue_depth(self):
+                return 0
+
+            def service_ewma_s(self):
+                return None
+
+        admission._reset_module()
+        try:
+            gate = AdmissionGate(Stub())
+            before = metrics.default.counter("admission.errors")
+            faults.configure("admission.gate=error#1")
+            assert gate.admit() is None          # admitted, not shed
+            gate.release()
+            assert metrics.default.counter("admission.errors") \
+                == before + 1
+            faults.clear()
+            assert gate.admit() is None
+            gate.release()
+            assert metrics.default.counter("admission.errors") \
+                == before + 1
+        finally:
+            admission._reset_module()
+
     def test_state_save_failpoint(self, tmp_path):
         from reporter_tpu.streaming.anonymiser import Anonymiser, TileSink
         from reporter_tpu.streaming.batcher import PointBatcher
@@ -610,6 +645,9 @@ class TestDrainer:
         d = DeadLetterDrainer(root, submit=lambda body: None,
                               interval_s=10.0, max_attempts=3,
                               base_backoff_s=5.0,
+                              # exact-schedule test: the seeded jitter
+                              # has its own pins (test_admission.py)
+                              backoff_jitter=0.0,
                               clock=lambda: now[0])
         assert d.maybe_drain() == 0          # attempt 1 fails
         now[0] = 2.0

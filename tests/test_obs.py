@@ -272,6 +272,55 @@ class TestSLO:
         monkeypatch.setenv(slo.ENV_VAR, "garbage")
         assert slo.check(Registry()) == {"targets": {}, "breaches": []}
 
+    def test_malformed_spec_warning_counted_once(self, monkeypatch):
+        """Fail-open is counted (slo.malformed) — but once per NEW spec
+        value, not once per health probe."""
+        from reporter_tpu.utils import metrics
+        monkeypatch.setenv(slo.ENV_VAR, "surely=not=a=spec")
+        slo._cache_spec = None  # drop any cached verdict
+        before = metrics.default.counter("slo.malformed")
+        assert slo.thresholds() == {}
+        assert metrics.default.counter("slo.malformed") == before + 1
+        assert slo.thresholds() == {}  # cached: no second count
+        assert metrics.default.counter("slo.malformed") == before + 1
+
+    def test_unknown_stage_names_ignored(self, monkeypatch):
+        """A target naming a stage that never ran is inert — it can
+        neither breach nor error."""
+        r = Registry()
+        for _ in range(10):
+            r.observe("real.stage", 0.5)
+        monkeypatch.setenv(slo.ENV_VAR,
+                           "no.such.stage=1,real.stage=5000")
+        out = slo.check(r)
+        assert out["breaches"] == []
+        assert set(out["targets"]) == {"no.such.stage", "real.stage"}
+
+    def test_budget_zero_never_flips_health(self, monkeypatch):
+        """``stage=0`` is malformed (budgets must be > 0), so the WHOLE
+        spec fails open — a zero budget must never 503 a healthy
+        service by making every observation a breach."""
+        r = Registry()
+        r.observe("stage", 0.001)
+        monkeypatch.setenv(slo.ENV_VAR, "stage=0")
+        slo._cache_spec = None
+        out = slo.check(r)
+        assert out["targets"] == {} and out["breaches"] == []
+
+    def test_spec_reload_between_requests(self, monkeypatch):
+        """The spec is re-read per check (cached per VALUE): an
+        operator retuning budgets between requests needs no restart."""
+        r = Registry()
+        for _ in range(10):
+            r.observe("stage", 0.5)
+        monkeypatch.setenv(slo.ENV_VAR, "stage=10000")
+        assert slo.check(r)["breaches"] == []
+        monkeypatch.setenv(slo.ENV_VAR, "stage=1")
+        assert len(slo.check(r)["breaches"]) == 1
+        monkeypatch.delenv(slo.ENV_VAR)
+        out = slo.check(r)
+        assert out["targets"] == {} and out["breaches"] == []
+
 
 # ---------------------------------------------------------------------------
 _SAMPLE_RE = re.compile(

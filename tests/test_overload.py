@@ -1,0 +1,83 @@
+"""Overload-proof scale legs and the bigreplay->ledger feed (ISSUE 15).
+
+The fast tests pin the committed 100k-probe BIGREPLAY artifact and its
+ledger normalisation; the slow-marked test re-runs the scaled replay
+end-to-end (the same harness is 1M-capable: ``--probes 1000000`` on a
+box with the minutes to spend — throughput measured here is ~15k
+probes/s on the 2-core CI container)."""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class TestBigreplayArtifact:
+    def test_committed_scaled_artifact(self):
+        """The checked-in 100k local run: full scale, >=99% agreement,
+        and a fault ratio the full-scale 0.4 floor accepts."""
+        with open(os.path.join(REPO, "BIGREPLAY_r01.json")) as f:
+            art = json.load(f)
+        assert art["kind"] == "bigreplay"
+        assert art["probes"] >= 100_000
+        assert art["agreement"] >= 0.99
+        assert art["fault_throughput_ratio"] >= 0.4
+
+    def test_ledger_entry_normalisation(self):
+        from reporter_tpu.obs import ledger
+        entry = ledger._bigreplay_entry("BIGREPLAY_r01.json", {
+            "kind": "bigreplay", "probes": 100000, "agreement": 0.995,
+            "writers": 2, "fault_throughput_ratio": 0.87,
+            "clean": {"probes_per_s": 15000.0}})
+        assert entry["kind"] == "bigreplay"
+        assert entry["scope"] == "full"
+        assert entry["vs_baseline"] == 0.87
+        assert "agreement=0.995" in entry["context"]
+        smoke = ledger._bigreplay_entry("BIGREPLAY_x.json", {
+            "kind": "bigreplay", "probes": 3000, "agreement": 1.0,
+            "fault_throughput_ratio": 0.5})
+        assert smoke["scope"] == "smoke"
+
+    def test_bigreplay_kind_never_pools_with_bench(self):
+        """The chaos/clean ratio must not bleed into the bench
+        vs_baseline medians perf_gate compares against."""
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        import perf_gate
+        entries = [
+            {"kind": "bench", "scope": "full", "platform": "cpu",
+             "vs_baseline": 20.0},
+            {"kind": "bigreplay", "scope": "full", "platform": "cpu",
+             "vs_baseline": 0.9},
+        ]
+        pool = perf_gate.comparable_pool(entries, "cpu", "full")
+        assert len(pool) == 1 and pool[0]["kind"] == "bench"
+
+    def test_seeded_ledger_contains_bigreplay(self):
+        from reporter_tpu.obs import ledger
+        entries = ledger.seed_entries(REPO)
+        big = [e for e in entries if e["kind"] == "bigreplay"]
+        assert big, "committed BIGREPLAY artifacts must seed the ledger"
+        assert all(e["vs_baseline"] for e in big)
+
+
+@pytest.mark.slow
+class TestScaledReplay:
+    def test_100k_probe_replay(self, tmp_path):
+        """The local scaled leg: 100k probes through the real
+        multi-writer chaos replay, gated at the full-scale floor.
+        (Swap --probes for 1000000 for the 1M leg — same harness,
+        ~10x the wall.)"""
+        out = tmp_path / "bigreplay_scaled.json"
+        env = dict(os.environ, REPORTER_TPU_PLATFORM="cpu")
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools/bigreplay.py"),
+             "--probes", "100000", "--writers", "2",
+             "--agreement-sample", "30", "--out", str(out)],
+            env=env, capture_output=True, text=True, timeout=1800)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        art = json.loads(out.read_text())
+        assert art["agreement"] >= 0.99
+        assert art["fault_throughput_ratio"] >= 0.4

@@ -1134,6 +1134,235 @@ def scenario_lease_kill() -> int:
     return 0
 
 
+def scenario_overload_recovery() -> int:
+    """Load management end-to-end (ISSUE 15): drive the service past
+    capacity with admission armed -> the gate sheds (counted, every
+    429 carrying Retry-After) and the pressure ladder steps DOWN with
+    its rung effects applied; an injected ``admission.gate`` fault
+    fails OPEN (admitted, counted); cut the load -> the ladder steps
+    back UP under hysteresis via /health ticks, /health returns 200
+    with zero open breakers, and a backpressure-shed streaming spool
+    drains to empty through the dead-letter drainer."""
+    import threading
+
+    import numpy as np
+
+    from reporter_tpu.matcher import SegmentMatcher
+    from reporter_tpu.service import admission
+    from reporter_tpu.service.server import ReporterService
+    from reporter_tpu.synth import generate_trace
+    from reporter_tpu.utils import faults, metrics
+
+    env_keys = ("REPORTER_TPU_ADMISSION", "REPORTER_TPU_SLO_MS",
+                "REPORTER_TPU_QUEUE_MAX", "REPORTER_TPU_INFLIGHT_MAX",
+                "REPORTER_TPU_PRESSURE_HOLD_S")
+    saved = {k: os.environ.get(k) for k in env_keys}
+    service = None
+    try:
+        # the health-SLO budget is generous on purpose: pressure in
+        # this scenario comes from the bounded queue, and recovery
+        # must be able to show a 200 /health (the lifetime p99 of
+        # admitted requests stays far under 5 s)
+        os.environ["REPORTER_TPU_ADMISSION"] = "1"
+        os.environ["REPORTER_TPU_SLO_MS"] = "service.handle=5000"
+        os.environ["REPORTER_TPU_QUEUE_MAX"] = "4"
+        os.environ["REPORTER_TPU_INFLIGHT_MAX"] = "4"
+        os.environ["REPORTER_TPU_PRESSURE_HOLD_S"] = "0.1"
+        metrics.default.reset()
+        admission._reset_module()
+
+        city = _city()
+        rng = np.random.default_rng(23)
+        reqs = []
+        for i in range(6):
+            tr = None
+            while tr is None:
+                tr = generate_trace(city, f"ovl-{i}", rng, noise_m=3.0,
+                                    min_route_edges=6)
+            reqs.append({"uuid": tr.uuid, "trace": tr.points,
+                         "match_options": {"mode": "auto",
+                                           "report_levels": [0, 1],
+                                           "transition_levels": [0, 1]}})
+        service = ReporterService(SegmentMatcher(net=city),
+                                  threshold_sec=15, max_batch=8,
+                                  max_wait_ms=5.0)
+        if service.admission is None:
+            return fail("REPORTER_TPU_ADMISSION=1 built no gate")
+        # deterministic capacity: a per-trace service floor stands in
+        # for device decode cost (the same device-cost model
+        # tools/overload.py uses)
+        orig_match = service.dispatcher._match_many
+        service.dispatcher._match_many = \
+            lambda b: (time.sleep(0.03 * len(b)), orig_match(b))[1]
+
+        def call(req):
+            gate = service.admission
+            shed = gate.admit()
+            if shed is not None:
+                return 429, shed.retry_after_s
+            try:
+                code, body = service.handle(dict(req))
+            finally:
+                gate.release()
+            retry = None
+            if code == 429:
+                # the dispatcher-backstop shed: its Retry-After rides
+                # the body (the HTTP handler lifts it into the header)
+                try:
+                    retry = json.loads(body).get("retry_after_s")
+                except Exception:
+                    pass
+            return code, retry
+
+        # ---- phase 1: drive past capacity -------------------------
+        results = []
+        res_lock = threading.Lock()
+        stop = threading.Event()
+
+        def hammer(idx):
+            while not stop.is_set():
+                got = call(reqs[idx % len(reqs)])
+                with res_lock:
+                    results.append(got)
+                if got[0] == 429:
+                    # a well-behaved client backs off; a spinning one
+                    # would just measure how fast 429s render
+                    time.sleep(0.01)
+
+        threads = [threading.Thread(target=hammer, args=(i,),
+                                    daemon=True) for i in range(12)]
+        for th in threads:
+            th.start()
+        time.sleep(1.5)
+        stop.set()
+        for th in threads:
+            th.join(timeout=60.0)
+        sheds = [r for r in results if r[0] == 429]
+        oks = [r for r in results if r[0] == 200]
+        errors = [r for r in results if r[0] not in (200, 429)]
+        if errors:
+            return fail(f"{len(errors)} hard errors under overload")
+        if not sheds or not oks:
+            return fail(f"expected both sheds and admits: "
+                        f"{len(sheds)} sheds / {len(oks)} oks")
+        if any(not r[1] or r[1] <= 0 for r in sheds):
+            return fail("a shed carried no positive Retry-After")
+        reg = metrics.default
+        counted = sum(reg.counter(f"admission.shed.{r}") for r in
+                      ("queue", "slo", "inflight")) \
+            + reg.counter("dispatch.queue.rejected") \
+            + reg.counter("dispatch.queue.evicted")
+        if counted < len(sheds):
+            return fail(f"{len(sheds)} sheds but only {counted} "
+                        "counted — silent loss on the shed path")
+        level_down = admission.current_level()
+        if level_down < 1:
+            return fail("sustained sheds never stepped the ladder down")
+        from reporter_tpu.obs import profiler as prof_mod
+        if level_down >= 1 and not prof_mod.shadow_stats()["suspended"]:
+            return fail("shed_shadow rung did not suspend the sampler")
+        log(f"overload: {len(oks)} admitted, {len(sheds)} shed "
+            f"(all counted, Retry-After set), ladder at "
+            f"{admission.RUNGS[level_down]}")
+
+        # ---- phase 2: injected gate fault fails OPEN ---------------
+        faults.configure("admission.gate=error#1")
+        code, _retry = call(reqs[0])
+        faults.configure("")
+        if code != 200:
+            return fail(f"gate fault did not fail open (got {code})")
+        if not reg.counter("admission.errors"):
+            return fail("gate fault was not counted")
+        log("gate fault failed open: request admitted, error counted")
+
+        # ---- phase 3: cut load; ladder steps back up via /health --
+        deadline = time.monotonic() + 20.0
+        code = None
+        while time.monotonic() < deadline:
+            code, _body = service.health()
+            if admission.current_level() == 0:
+                break
+            time.sleep(0.05)
+        if admission.current_level() != 0:
+            return fail(f"ladder stuck at level "
+                        f"{admission.current_level()} after load cut")
+        code, body = service.health()
+        health = json.loads(body)
+        if code != 200:
+            return fail(f"/health {code} after recovery: {body[:300]}")
+        if health["degraded"]["open"]:
+            return fail(f"open breakers after recovery: "
+                        f"{health['degraded']['open']}")
+        if health["pressure"]["level"] != 0 \
+                or health["pressure"]["transitions"] < 2:
+            return fail(f"pressure block wrong: {health['pressure']}")
+        if prof_mod.shadow_stats()["suspended"]:
+            return fail("shadow sampling still suspended at level 0")
+        log(f"recovered: /health 200, ladder at normal after "
+            f"{health['pressure']['transitions']} transitions")
+
+        # ---- phase 4: a backpressure-shed spool drains ------------
+        from reporter_tpu.streaming.backpressure import \
+            BackpressureGovernor
+        from reporter_tpu.streaming.batcher import PointBatcher
+        from reporter_tpu.streaming.drainer import DeadLetterDrainer
+        with tempfile.TemporaryDirectory() as spool_dir:
+            trace_spool = os.path.join(spool_dir, ".traces")
+            def resubmit(body):
+                code, resp = service.handle(dict(body))
+                if code != 200:
+                    return None
+                if not isinstance(resp, str):
+                    resp = bytes(resp).decode("utf-8")
+                return json.loads(resp)
+
+            governor = BackpressureGovernor(latency_high_s=0.001,
+                                            depth_high=1)
+            governor.ewma_s = 1.0  # pinned severe pressure
+            batcher = PointBatcher(
+                resubmit, lambda k, s: None,
+                deadletter_dir=trace_spool, governor=governor)
+            if not batcher.governor.should_shed():
+                return fail("governor not shedding at pinned pressure")
+            from reporter_tpu.core.types import Point
+            t0 = 1700000000
+            for i in range(12):
+                batcher.process("bp-veh", Point(
+                    lat=0.001 * i, lon=0.0, time=t0 + 30 * i,
+                    accuracy=5.0), (t0 + 30 * i) * 1000)
+            shed_count = metrics.default.counter("backpressure.shed")
+            files = [f for f in os.listdir(trace_spool)
+                     if f.endswith(".json")] \
+                if os.path.isdir(trace_spool) else []
+            if not shed_count or not files:
+                return fail(f"backpressure shed nothing "
+                            f"(count={shed_count}, files={files})")
+            # recovery: replay the spool through the REAL service
+            drainer = DeadLetterDrainer(
+                spool_dir, trace_root=trace_spool, submit=resubmit,
+                forward=lambda key, seg: None)
+            drainer.drain_now()
+            left = [f for f in os.listdir(trace_spool)
+                    if f.endswith(".json")]
+            if left:
+                return fail(f"spool did not drain: {left}")
+            log(f"backpressure: {shed_count} session(s) shed to the "
+                "spool under pinned pressure, drained to empty on "
+                "recovery")
+        return 0
+    finally:
+        faults.configure("")
+        if service is not None:
+            service.dispatcher.close()
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        metrics.default.reset()
+        admission._reset_module()
+
+
 SCENARIOS = {
     "storm": scenario_storm,
     "kill_restore": scenario_kill_restore,
@@ -1144,6 +1373,7 @@ SCENARIOS = {
     "double_ingest": scenario_double_ingest,
     "replay_drain": scenario_replay_drain,
     "lease_kill": scenario_lease_kill,
+    "overload_recovery": scenario_overload_recovery,
 }
 
 
