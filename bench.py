@@ -433,6 +433,114 @@ def _query_leg(n_segments: int = 256, repeats: int = 3):
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def _routes_leg(city, matcher, params, reqs, n_chunk: int = 64,
+                repeats: int = 3):
+    """The route-kernel triple (ISSUE 16): the same chunk's candidate
+    pairs costed three ways — the chunk-batched device relax
+    (graph/route_device.py, its serving shape: ONE fill per chunk), the
+    per-trace host Dijkstra (graph/route.py, warm RouteCache) and the
+    per-trace native memo (rt_route_matrices, warm memo). BEFORE any
+    timing, the serving paths (batch prep, per-trace native, device
+    fill) must agree byte-identical — the speedup must never be a
+    different answer. The numpy reference accumulates in float64 and
+    casts on store, so it is held to the seed's route tolerance
+    (rtol=1e-5, atol=1e-3) instead of bytes. Best-of-N wall per leg;
+    ``device_vs_native`` is the route-stage speedup the pipelined
+    prep_share should reflect when REPORTER_TPU_ROUTE_DEVICE is on."""
+    if matcher.runtime is None:
+        return {"skipped": "no native runtime: the native prep tensors "
+                "are the shared pair workload"}
+    from reporter_tpu.graph.route import RouteCache, candidate_route_matrices
+    from reporter_tpu.graph.route_device import DeviceRouteKernel
+    from reporter_tpu.graph.spatial import CandidateSet
+    from reporter_tpu.matcher.batchpad import prepare_batch
+
+    kern = DeviceRouteKernel(city)
+    sub = [r["trace"] for r in reqs[:n_chunk]]
+    T = matcher.prepare(sub[0]).T
+    host = prepare_batch(matcher.runtime, sub, params, T, n_threads=0)
+    prep = dict(host.prep)
+    B = len(sub)
+
+    def _trace_cands(b):
+        nk = int(prep["num_kept"][b])
+        edge = prep["edge_ids"][b, :nk]
+        off = prep["offset_m"][b, :nk]
+        z = np.zeros_like(off)
+        cands = CandidateSet(edge_ids=edge, dist_m=prep["dist_m"][b, :nk],
+                             offset_m=off, proj_x=z, proj_y=z)
+        gc = prep["gc_m"][b, :max(nk - 1, 0)]
+        dt = prep["dt"][b, :max(nk - 1, 0)] \
+            if params.max_route_time_factor > 0 and nk > 1 else None
+        return nk, cands, gc, dt
+
+    kw = dict(max_route_distance_factor=params.max_route_distance_factor,
+              backward_tolerance_m=params.backward_tolerance_m,
+              max_route_time_factor=params.max_route_time_factor,
+              min_time_bound_s=params.min_time_bound_s,
+              turn_penalty_factor=params.turn_penalty_factor)
+    cache = RouteCache(city)
+
+    # -- parity BEFORE timing: all three paths, identical pairs ----------
+    n_pairs = 0
+    for b in range(B):
+        nk, cands, gc, dt = _trace_cands(b)
+        if nk < 2:
+            continue
+        oracle = prep["route_m"][b, :nk - 1]
+        nat = matcher.runtime.route_matrices(cands, gc, dt=dt, **kw)
+        np_route = candidate_route_matrices(city, cands, gc, cache=cache,
+                                            dt=dt, **kw)
+        if not np.array_equal(oracle, nat):
+            raise RuntimeError(f"native route paths disagree on trace {b} "
+                               "— parity broken, timings void")
+        if not np.allclose(oracle, np_route, rtol=1e-5, atol=1e-3):
+            raise RuntimeError(f"numpy route reference disagrees on trace "
+                               f"{b} — parity broken, timings void")
+        n_pairs += int((cands.edge_ids[:-1] != -1).sum()) \
+            * cands.edge_ids.shape[1]
+    dev = dict(prep)
+    dev["route_m"] = prep["route_m"].copy()
+    dev["max_finite"] = prep["max_finite"].copy()
+    kern.fill_prep(dev, params, B)  # also warms the jit cache
+    if not np.array_equal(dev["route_m"], prep["route_m"]):
+        raise RuntimeError("device route tensor differs from the host "
+                           "oracle — parity broken, timings void")
+
+    # -- timed legs over the identical, parity-proven workload -----------
+    best_dev = best_host = best_nat = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        kern.fill_prep(dev, params, B)
+        best_dev = min(best_dev, time.perf_counter() - t0)
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        for b in range(B):
+            nk, cands, gc, dt = _trace_cands(b)
+            if nk >= 2:
+                candidate_route_matrices(city, cands, gc, cache=cache,
+                                         dt=dt, **kw)
+        best_host = min(best_host, time.perf_counter() - t0)
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        for b in range(B):
+            nk, cands, gc, dt = _trace_cands(b)
+            if nk >= 2:
+                matcher.runtime.route_matrices(cands, gc, dt=dt, **kw)
+        best_nat = min(best_nat, time.perf_counter() - t0)
+    return {
+        "n_traces": B,
+        "T": int(T),
+        "n_pairs": n_pairs,
+        "parity": "byte-identical",
+        "device_s": round(best_dev, 6),
+        "host_s": round(best_host, 6),
+        "native_s": round(best_nat, 6),
+        "device_vs_host": round(best_host / best_dev, 2),
+        "device_vs_native": round(best_nat / best_dev, 2),
+    }
+
+
 def main():
     n_traces = int(os.environ.get("BENCH_TRACES", 512))
     n_base = int(os.environ.get("BENCH_BASELINE_TRACES", 128))
@@ -519,6 +627,15 @@ def main():
     if platform == "cpu" and pipeline_unset:
         os.environ["REPORTER_TPU_PIPELINE"] = "1"
 
+    # the batched leg runs with the device route kernel ON by default
+    # (BENCH_ROUTE_DEVICE=0 opts out): the committed artifact measures
+    # the chunk-batched relax as the serving route path, with the host
+    # Dijkstra held to byte-parity by the routes leg below. An explicit
+    # REPORTER_TPU_ROUTE_DEVICE in the environment wins.
+    if os.environ.get("BENCH_ROUTE_DEVICE", "1") not in ("0", "off",
+                                                         "false"):
+        os.environ.setdefault("REPORTER_TPU_ROUTE_DEVICE", "1")
+
     city, matcher, params, reqs, tb = build_inputs(n_traces, T_bucket, K)
     sigma = np.float32(params.effective_sigma)
     beta = np.float32(params.beta)
@@ -599,6 +716,14 @@ def main():
     except Exception as e:  # record the failure, keep the artifact
         query_field = {"error": str(e)[:200]}
 
+    # -- route-kernel triple (ISSUE 16) -----------------------------------
+    # device relax vs host Dijkstra vs native memo on identical pairs;
+    # parity asserted byte-identical inside the leg before any timing
+    try:
+        routes_field = _routes_leg(city, matcher, params, reqs)
+    except Exception as e:  # record the failure, keep the artifact
+        routes_field = {"error": str(e)[:200]}
+
     # -- optional second decode backend: the fused pallas kernel ----------
     # recorded in the same artifact so hardware claims in docstrings trace
     # to a committed number; default-on only where it runs compiled (tpu)
@@ -641,6 +766,7 @@ def main():
         "compile": compile_field,
         "bucketing": bucketing_field,
         "query": query_field,
+        "routes": routes_field,
         "probe": dict(rt.probe_info,
                       **({"pipelined_probe": probe_pipelined}
                          if probe_pipelined else {})),

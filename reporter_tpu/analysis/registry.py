@@ -39,6 +39,9 @@ ENV_KNOBS: Dict[str, str] = {
     "REPORTER_TPU_PREP_THREADS": "native prep worker-pool width",
     "REPORTER_TPU_PREP_TIMINGS": "print native prep phase times",
     "REPORTER_TPU_ROUTE_MEMO": "native cross-call route-pair memo size",
+    "REPORTER_TPU_ROUTE_DEVICE": "device route-cost kernel on/off",
+    "REPORTER_TPU_ROUTE_PRUNE_SIGMA": "candidate prune margin, sigma mult",
+    "REPORTER_TPU_ROUTE_HOPS": "device relax sweep cap (0 = auto)",
     "REPORTER_TPU_ROUTE_CACHE_NODES": "numpy route cache: node entries",
     "REPORTER_TPU_ROUTE_CACHE_PAIRS": "numpy route cache: pair entries",
     "REPORTER_TPU_WIRE": "f16|f32 device wire format",
@@ -101,6 +104,7 @@ METRICS: Dict[str, str] = {
     "matcher.assemble": "run walk + column conversion (timer)",
     "matcher.circuit.*": "breaker transitions + degraded-chunk counts",
     "prep.phase.*": "native prep phase split (candidates/select/routes)",
+    "route.device.*": "device route kernel: chunks/sources/fallbacks",
     # numpy route cache
     "route.cache.node_hits": "route cache: node-level hits",
     "route.cache.node_misses": "route cache: node-level misses",
@@ -224,6 +228,7 @@ FAULT_SITES: Dict[str, str] = {
     "worker.post_egress": "crash between sink ack and epoch marker",
     "wire.native": "native wire-writer fault -> Python writer, same bytes",
     "admission.gate": "gate/sensor failure -> fail OPEN (admit), counted",
+    "route.device": "device route fill error -> native re-prep with routes",
 }
 
 # ---- durable layout roots --------------------------------------------------

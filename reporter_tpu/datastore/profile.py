@@ -58,6 +58,13 @@ def export_profile(matcher, path: str, cap: int = 1 << 16,
         ea, eb = matcher.runtime.route_memo_export(cap)
         pairs = np.stack([ea, eb], axis=1).tolist() if ea.size else []
         stats = matcher.runtime.route_memo_stats()
+    # frontier-bound table: the device route kernel's observed relaxation
+    # depth + chunk bound over the replay (None when the kernel never
+    # ran). Warming seeds the next residency's sweep cap from it, so a
+    # freshly loaded city relaxes to the recorded frontier instead of
+    # the worst-case node count.
+    kern = getattr(matcher, "_route_kernel", None)
+    route_table = kern.stats() if kern is not None else None
     art = {
         "version": 1,
         "city": city,
@@ -65,6 +72,7 @@ def export_profile(matcher, path: str, cap: int = 1 << 16,
         # the replay's memo counters: how warm the memo that produced
         # this profile actually was (an all-miss replay exports noise)
         "memo_stats": stats,
+        "route_table": route_table,
         "pairs": pairs,
     }
     fsio.atomic_write_text(path, json.dumps(art, separators=(",", ":")))
@@ -96,7 +104,26 @@ def warm_matcher(matcher, profile: Optional[dict],
     returns pairs warmed (0 on the numpy fallback, an empty profile, or
     a disabled memo). Out-of-range edge ids — a profile exported from a
     different graph build — are skipped inside the native call."""
-    if profile is None or getattr(matcher, "runtime", None) is None:
+    if profile is None:
+        return 0
+    # seed the device route kernel's sweep cap from the artifact's
+    # frontier-bound table (route.device path; a malformed table costs
+    # only the hint). The kernel is built here iff the knob enables it —
+    # city load is exactly where the one-time build belongs.
+    table = profile.get("route_table")
+    if isinstance(table, dict):
+        try:
+            build = getattr(matcher, "_device_route_kernel", None)
+            kern = build() if build is not None else None
+            if kern is not None:
+                kern.seed_hint(int(table.get("route_hops") or 0))
+            # warmed host kernels prove themselves to the observed
+            # serving bound, not just the floor — a kernel proven to a
+            # smaller bound than a query needs re-searches anyway
+            bound_m = max(bound_m, float(table.get("route_bound_m") or 0))
+        except (TypeError, ValueError) as e:
+            logger.warning("malformed profile route_table (ignored): %s", e)
+    if getattr(matcher, "runtime", None) is None:
         return 0
     pairs = profile.get("pairs") or []
     if not pairs:

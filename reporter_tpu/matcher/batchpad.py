@@ -35,6 +35,16 @@ from .params import MatchParams
 
 LENGTH_BUCKETS = (16, 64, 256, 1024)
 
+#: FLASH-style candidate pruning margin, in multiples of the HMM's
+#: effective sigma: after the distance-sorted candidate gather, a
+#: point's candidates beyond ``dist[0] + sigma_mult * effective_sigma``
+#: are dropped BEFORE any route between them is requested — their
+#: emission probability is already vanishing relative to the best
+#: candidate, so the route columns they'd occupy are near-certain
+#: Viterbi losers. 0 (default) disables pruning; the shadow-accuracy
+#: sampler (obs/shadow.py) is the guard rail when arming it.
+ENV_PRUNE = "REPORTER_TPU_ROUTE_PRUNE_SIGMA"
+
 #: runtime bucket-ladder override: "16,64,256,1024" (ascending ints),
 #: with an optional "@<waste>" suffix setting the occupancy-driven
 #: split threshold ("@1" / "@off" disables splitting). Default: the
@@ -277,6 +287,7 @@ def _prepare_from_candidates(net, lat, lon, times, all_cands, has_cands,
         edge_ids=all_cands.edge_ids[kept], dist_m=all_cands.dist_m[kept],
         offset_m=all_cands.offset_m[kept], proj_x=all_cands.proj_x[kept],
         proj_y=all_cands.proj_y[kept])
+    cands = _prune_candidates(cands, _route_prune_margin(params))
 
     gc = equirectangular_m(lat[kept[:-1]], lon[kept[:-1]],
                            lat[kept[1:]], lon[kept[1:]]) if n > 1 else np.zeros(0)
@@ -385,12 +396,27 @@ class PaddedBatch:
     prep: dict | None = None
     pt_off: np.ndarray | None = None     # (B+1,) i64
     times_flat: np.ndarray | None = None  # flat f64 raw probe times
+    # deferred wire finalisation (the device-resident route path of
+    # prepare_batch(defer_routes=True)): the decode stage runs it once
+    # before reading the batch tensors, paying the device sync there —
+    # overlapped with the next chunk's native prep — instead of in prep
+    finalize: "object | None" = None
+
+    def finalize_wire(self) -> None:
+        """Run the deferred route write-back + wire-dtype cast; no-op
+        when the batch was built synchronously."""
+        f, self.finalize = self.finalize, None
+        if f is not None:
+            f(self)
 
 
 def prepare_batch(runtime, traces_points: Sequence[Sequence[dict]],
                   params: MatchParams, T: int,
                   pad_rows: int | None = None,
-                  n_threads: int = 0) -> PaddedBatch:
+                  n_threads: int = 0,
+                  route_kernel=None,
+                  route_circuit=None,
+                  defer_routes: bool = False) -> PaddedBatch:
     """Whole-chunk host prep through ONE native call (the hot path).
 
     Same per-trace semantics as :func:`prepare_trace` — the C++ side
@@ -411,6 +437,24 @@ def prepare_batch(runtime, traces_points: Sequence[Sequence[dict]],
     rows (mesh divisibility / pow2 shape bounding). Float tensors ship on
     the f16 wire when every finite distance fits (same policy as
     pack_batches).
+
+    ``route_kernel`` (graph/route_device.py DeviceRouteKernel) moves the
+    route-cost stage onto the device: the native call runs with
+    ``skip_routes`` and the kernel fills ``route_m`` from one batched
+    bounded relaxation. Any device failure (or an open ``route_circuit``)
+    falls back to a native re-prep WITH routes — byte-identical output,
+    just slower — and records the outcome on the circuit so a sick
+    device stops being retried per-chunk.
+
+    ``defer_routes=True`` (the pipelined matcher's mode) keeps the
+    device route tensor DEVICE-RESIDENT: the assembly is dispatched in
+    prep but never synced here — ``route_m`` on the returned batch is
+    the in-flight device array (padded to the native wire layout) and
+    the batch carries a ``finalize`` closure the decode stage runs
+    before reading tensors, which pays the sync + wire-f16 decision
+    there, overlapped with the next chunk's native prep. Every device
+    failure still raises at dispatch time, inside this call, so circuit
+    and fallback semantics are identical to the synchronous path.
 
     Returns a PaddedBatch whose ``traces`` are PreparedTrace *views* over
     the batch tensors (rows of the pre-cast f32 arrays), usable by
@@ -435,19 +479,53 @@ def prepare_batch(runtime, traces_points: Sequence[Sequence[dict]],
         times = np.fromiter((p["time"] for pts in traces_points for p in pts),
                             np.float64, n_pts)
 
-    out = runtime.prepare_batch(
-        pt_off, lat, lon, times, T, params.max_candidates,
-        search_radius=params.search_radius,
-        interpolation_distance=params.interpolation_distance,
-        breakage_distance=params.breakage_distance,
-        max_route_distance_factor=params.max_route_distance_factor,
-        backward_tolerance_m=params.backward_tolerance_m,
-        max_route_time_factor=params.max_route_time_factor,
-        min_time_bound_s=params.min_time_bound_s,
-        turn_penalty_factor=params.turn_penalty_factor,
-        n_threads=n_threads, n_rows=pad_rows)
+    use_device = route_kernel is not None and \
+        (route_circuit is None or route_circuit.allow())
+    if route_kernel is not None and not use_device:
+        from ..utils import metrics
+        metrics.count("route.device.circuit_skipped_chunks")
+
+    def native_prep(skip_routes: bool) -> dict:
+        return runtime.prepare_batch(
+            pt_off, lat, lon, times, T, params.max_candidates,
+            search_radius=params.search_radius,
+            interpolation_distance=params.interpolation_distance,
+            breakage_distance=params.breakage_distance,
+            max_route_distance_factor=params.max_route_distance_factor,
+            backward_tolerance_m=params.backward_tolerance_m,
+            max_route_time_factor=params.max_route_time_factor,
+            min_time_bound_s=params.min_time_bound_s,
+            turn_penalty_factor=params.turn_penalty_factor,
+            prune_margin_m=_route_prune_margin(params),
+            skip_routes=skip_routes,
+            n_threads=n_threads, n_rows=pad_rows)
+
+    out = native_prep(skip_routes=use_device)
+    pending = None
+    if use_device:
+        from ..obs import trace as obs_trace
+        from ..utils import metrics
+        try:
+            with obs_trace.span("prep.routes_device"):
+                pending = route_kernel.fill_prep(out, params, B,
+                                                 defer=defer_routes)
+        except Exception:
+            if route_circuit is not None:
+                route_circuit.record_failure()
+            metrics.count("route.device.errors")
+            metrics.count("route.device.fallback_chunks")
+            import logging
+            logging.getLogger("reporter_tpu.matcher").warning(
+                "device route kernel failed; re-prepping chunk with host "
+                "routes", exc_info=True)
+            out = native_prep(skip_routes=False)
+        else:
+            if route_circuit is not None:
+                route_circuit.record_success()
 
     def build_views() -> List[PreparedTrace]:
+        if pending is not None:
+            pending.write_back(out)
         edge_ids, kept, num_kept = out["edge_ids"], out["kept_idx"], \
             out["num_kept"]
         views = []
@@ -473,14 +551,94 @@ def prepare_batch(runtime, traces_points: Sequence[Sequence[dict]],
     # identically — matcher/hmm.py). The cast runs in native code
     # (F16C); numpy's f16 astype was the top host cost after batching.
     dist, route, gc = out["dist_m"], out["route_m"], out["gc_m"]
-    if _wire_f16() and float(out["max_finite"][0]) <= WIRE_MAX_M:
+    finalize = None
+    if pending is not None:
+        # device-resident: route_m is installed by finalize (the
+        # deferred handle may still be a dispatch future on a warm
+        # cache); the wire dtype is decided at decode time from the
+        # SAME total max the sync path folds (device route bytes are
+        # host-identical, so the decision — and therefore the f16
+        # quantisation — matches exactly)
+        rows = int(dist.shape[0])
+        route = None
+
+        def finalize(batch, _p=pending, _rows=rows):
+            import jax.numpy as jnp
+
+            from ..utils import metrics
+            try:
+                route_dev, _mx = _p.resolve()
+            except Exception:
+                # a warm-cache async dispatch died off-thread (device
+                # lost mid-flight); the decode lane surfaces it — the
+                # chunk has no route bytes to degrade onto anyway
+                metrics.count("route.device.finalize_errors")
+                raise
+            batch.route_m = _device_route_full(route_dev, _rows, T)
+            _p.write_back(out)
+            if _wire_f16() and float(out["max_finite"][0]) <= WIRE_MAX_M:
+                batch.dist_m = runtime.to_f16(out["dist_m"])
+                batch.gc_m = runtime.to_f16(out["gc_m"])
+                batch.route_m = batch.route_m.astype(jnp.float16)
+    elif _wire_f16() and float(out["max_finite"][0]) <= WIRE_MAX_M:
         dist = runtime.to_f16(dist)
         route = runtime.to_f16(route)
         gc = runtime.to_f16(gc)
     return PaddedBatch(traces=_LazyTraceViews(B, build_views), dist_m=dist,
                        valid=out["edge_ids"] != PAD_EDGE, route_m=route,
                        gc_m=gc, case=out["case"], prep=out,
-                       pt_off=pt_off, times_flat=times)
+                       pt_off=pt_off, times_flat=times, finalize=finalize)
+
+
+def _device_route_full(route_dev, rows: int, T: int):
+    """Pad a deferred (B, T-1, K, K) device route tensor out to the
+    native wire layout (rows, T, K, K): filler rows and the dead
+    trailing time step carry the UNREACHABLE sentinel — the same bytes
+    the native tail fill writes — so every decode shape and SKIP-row
+    behavior is identical to the host-materialised path. Runs as an
+    async device op; nothing here blocks."""
+    import jax.numpy as jnp
+    B = int(route_dev.shape[0])
+    return jnp.pad(route_dev, ((0, rows - B), (0, 1), (0, 0), (0, 0)),
+                   constant_values=np.float32(UNREACHABLE))
+
+
+def _route_prune_margin(params: MatchParams) -> float:
+    """Candidate pruning margin in meters (0 = pruning off), from
+    REPORTER_TPU_ROUTE_PRUNE_SIGMA x the params' effective sigma. A
+    malformed or negative value logs and disables pruning — a typo must
+    degrade to the exact (unpruned) semantics, never to surprise drops."""
+    spec = _os.environ.get(ENV_PRUNE, "").strip()
+    if not spec:
+        return 0.0
+    try:
+        mult = float(spec)
+        if mult < 0:
+            raise ValueError("must be >= 0")
+    except ValueError as e:
+        import logging
+        logging.getLogger("reporter_tpu.matcher").warning(
+            "%s=%r not understood (%s); candidate pruning stays off",
+            ENV_PRUNE, spec, e)
+        return 0.0
+    return mult * float(params.effective_sigma)
+
+
+def _prune_candidates(cands: CandidateSet, margin: float) -> CandidateSet:
+    """Numpy mirror of the native prune block: per point, drop the
+    distance-sorted suffix beyond ``dist[0] + margin``. The best
+    candidate always survives; pad slots stay pad."""
+    if margin <= 0 or cands.edge_ids.size == 0:
+        return cands
+    live = cands.edge_ids != PAD_EDGE
+    cut = (cands.dist_m > cands.dist_m[:, :1] + np.float32(margin)) & live
+    if not cut.any():
+        return cands
+    return CandidateSet(
+        edge_ids=np.where(cut, PAD_EDGE, cands.edge_ids),
+        dist_m=np.where(cut, PAD_DIST, cands.dist_m),
+        offset_m=np.where(cut, np.float32(0.0), cands.offset_m),
+        proj_x=cands.proj_x, proj_y=cands.proj_y)
 
 
 def _wire_f16() -> bool:

@@ -61,6 +61,14 @@ def _circuit_knobs() -> tuple:
             _env_float("REPORTER_TPU_CIRCUIT_COOLDOWN_S", 30.0))
 
 
+def _route_device_enabled() -> bool:
+    """REPORTER_TPU_ROUTE_DEVICE opts the device route kernel in (off by
+    default: the host path is the battle-tested oracle, and the kernel
+    only pays off where a real accelerator backs jax)."""
+    return os.environ.get("REPORTER_TPU_ROUTE_DEVICE", "").strip().lower() \
+        in ("1", "on", "true", "yes")
+
+
 def _decode_chunk() -> int:
     """Traces per decode dispatch. REPORTER_TPU_DECODE_CHUNK forces it;
     the default follows the pipeline mode: 128 when the device lanes
@@ -420,6 +428,8 @@ class SegmentMatcher:
         #                     (cpu_ref.viterbi_decode_numpy)
         #   circuit_assemble  native batched assembly -> per-trace scalar
         #                     assembly with poisoned-trace quarantine
+        #   circuit_route     device route kernel -> native re-prep with
+        #                     host routes (batchpad.prepare_batch)
         # Fallback outputs are pinned byte-identical (tests/
         # test_report_writer.py, TestDecodeDomain); a half-open probe
         # after the cooldown feels out recovery. The breakers exist even
@@ -435,6 +445,15 @@ class SegmentMatcher:
         self.circuit_assemble = CircuitBreaker("matcher.circuit.assemble",
                                                threshold=threshold,
                                                cooldown_s=cooldown)
+        self.circuit_route = CircuitBreaker("matcher.circuit.route",
+                                            threshold=threshold,
+                                            cooldown_s=cooldown)
+        # device route kernel (REPORTER_TPU_ROUTE_DEVICE): built lazily
+        # on the first native dispatch — jax import + column upload are
+        # not a cost the numpy-only paths should pay. False = build
+        # failed / disabled, None = not attempted yet.
+        self._route_kernel = None
+        self._route_kernel_tried = False
         # where a poisoned trace's request JSON lands when assembly
         # quarantines it (None -> the worker-registered trace spool via
         # utils.spool, else log-and-drop)
@@ -482,7 +501,27 @@ class SegmentMatcher:
     #: the worker heartbeat and the chaos assertions all read this map
     CIRCUIT_DOMAINS = (("native.prep", "circuit"),
                        ("decode.dispatch", "circuit_decode"),
-                       ("matcher.assemble", "circuit_assemble"))
+                       ("matcher.assemble", "circuit_assemble"),
+                       ("route.device", "circuit_route"))
+
+    def _device_route_kernel(self):
+        """The lazily-built device route kernel, or None when disabled,
+        unavailable, or its one-time build failed (logged once; the host
+        route path then serves every chunk)."""
+        if not self._route_kernel_tried:
+            self._route_kernel_tried = True
+            if _route_device_enabled() and self.runtime is not None:
+                try:
+                    from ..graph.route_device import DeviceRouteKernel
+                    self._route_kernel = DeviceRouteKernel(self.net)
+                except Exception as e:
+                    metrics.count("route.device.build_errors")
+                    logger.warning(
+                        "REPORTER_TPU_ROUTE_DEVICE is set but the device "
+                        "route kernel failed to build (%s); host routes "
+                        "serve every chunk", e)
+                    self._route_kernel = None
+        return self._route_kernel
 
     def circuit_snapshots(self) -> dict:
         """{domain: breaker snapshot} for every guarded hot-path stage."""
@@ -647,6 +686,12 @@ class SegmentMatcher:
         B, T, K = batch.dist_m.shape
         with metrics.timer("matcher.decode_dispatch"), \
                 profiler.dispatch_span(B, T, K):
+            # deferred device routes (prepare_batch defer_routes): sync
+            # the in-flight tensor + settle the wire dtype HERE, on the
+            # decode lane, so the prep stage stayed dispatch-only. Every
+            # consumer below (device decode, numpy oracle, pressure
+            # ladder) reads the finalised tensors.
+            batch.finalize_wire()
             if _pressure_oracle:
                 # the ladder's last rung: identical results (the oracle
                 # is the breaker's fallback, bit-identical on scan),
@@ -919,7 +964,15 @@ class SegmentMatcher:
                                     batch = prepare_batch(
                                         self.runtime, tb.gather(part),
                                         params, int(T), pad_rows=rows,
-                                        n_threads=workers)
+                                        n_threads=workers,
+                                        route_kernel=self
+                                        ._device_route_kernel(),
+                                        route_circuit=self.circuit_route,
+                                        # device-resident route tensor:
+                                        # the decode stage pays the sync
+                                        # (finalize_wire), overlapped
+                                        # with the next chunk's prep
+                                        defer_routes=True)
                             except Exception as e:
                                 self.circuit.record_failure()
                                 metrics.count(

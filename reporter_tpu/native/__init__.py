@@ -31,7 +31,7 @@ _LIB_PATH = os.path.join(_DIR, "libreporter_host.so")
 # Must equal host_runtime.cpp's rt_abi_version(). The handshake in
 # _get_lib() turns a half-landed ABI change (library and binding updated
 # in different commits) into a loud numpy fallback instead of a segfault.
-ABI_VERSION = 13
+ABI_VERSION = 14
 _lib = None
 # long_hold_ok: the once-only init hold (subprocess make + ABI
 # handshake, bounded by the 180 s build timeout) is the design — both
@@ -172,9 +172,9 @@ def _init_locked() -> Optional[ctypes.CDLL]:
             ctypes.c_double, ctypes.c_double, ctypes.c_double,
             ctypes.c_double, ctypes.c_double, ctypes.c_double,
             ctypes.c_double, ctypes.c_double, ctypes.c_double,
-            ctypes.c_int32,
+            ctypes.c_double, ctypes.c_int32, ctypes.c_int32,
             c_i32p, c_f32p, c_f32p, c_f32p, c_f32p, c_i32p, c_i32p, c_i32p,
-            c_f32p, c_u8p, c_f32p, c_i64p]
+            c_f32p, c_u8p, c_f32p, c_i64p, c_f64p]
         # columnar /report wire writer (ABI 12): pure functions over
         # borrowed run columns, no handle — see write_report_json below.
         # The ten column base addresses travel as ONE packed int64
@@ -507,6 +507,8 @@ class NativeRuntime:
                       max_route_time_factor: float = 0.0,
                       min_time_bound_s: float = 15.0,
                       turn_penalty_factor: float = 0.0,
+                      prune_margin_m: float = 0.0,
+                      skip_routes: bool = False,
                       n_threads: int = 0, n_rows: int | None = None):
         """Prepare B traces in ONE native call, straight into padded
         (rows, T, ...) batch tensors — candidates, jitter filtering, case
@@ -516,10 +518,21 @@ class NativeRuntime:
         lat/lon/times point arrays; ``n_rows`` >= B allocates extra
         all-SKIP filler rows (mesh/pow2 batch padding).
 
+        ``prune_margin_m`` > 0 arms FLASH-style candidate pruning after
+        kept selection: each row's distance-sorted candidates are cut
+        where dist > dist[0] + margin, shrinking K before any route is
+        requested (the best candidate always survives). ``skip_routes``
+        skips ONLY the route_step stage — the device route kernel
+        (graph/route_device.py) then owns route rows [0, n-1) of every
+        live trace; all other tensors (including the ``dt`` deltas the
+        device time cap needs) are computed as usual.
+
         Returns a dict of the filled tensors: edge_ids (rows,T,K) i32,
         dist_m/offset_m (rows,T,K) f32, route_m (rows,T,K,K) f32,
         gc_m (rows,T) f32, case (rows,T) i32, kept_idx (rows,T) i32
-        (-1 pad), num_kept (rows,) i32, dwell (rows,) f32.
+        (-1 pad), num_kept (rows,) i32, dwell (rows,) f32, dt (rows,T)
+        f64 kept-point probe time deltas (-1 where the time bound must
+        not arm: no next kept point, or the bound is off).
 
         route_m/gc_m carry T time rows — the final row is a dead step
         left at its pre-fill — so the dominant tensor ships to the
@@ -554,6 +567,9 @@ class NativeRuntime:
             "kept_idx": np.empty((rows, T), np.int32),
             "num_kept": np.zeros(rows, np.int32),
             "dwell": np.zeros(rows, np.float32),
+            # kept-point probe time deltas (f64: the device route kernel
+            # re-derives the exact time cap from them); -1 sentinel
+            "dt": np.empty((rows, T), np.float64),
             # per RAW point: had any candidate edge (flat over pt_off) —
             # distinguishes jitter drops from off-network drops in the
             # assembler's span attribution
@@ -575,6 +591,7 @@ class NativeRuntime:
             out["gc_m"][B:] = 0.0
             out["case"][B:] = SKIP
             out["kept_idx"][B:] = -1
+            out["dt"][B:] = -1.0
         lat0, lon0 = self.net.projection_anchor()
         self._lib.rt_prepare_batch(
             self._handle, B, pt_off, lat, lon, times,
@@ -583,11 +600,12 @@ class NativeRuntime:
             float(breakage_distance), float(max_route_distance_factor),
             float(min_bound_m), float(backward_tolerance_m),
             float(max_route_time_factor), float(min_time_bound_s),
-            float(turn_penalty_factor), int(n_threads),
+            float(turn_penalty_factor), float(prune_margin_m),
+            int(bool(skip_routes)), int(n_threads),
             out["edge_ids"], out["dist_m"], out["offset_m"],
             out["route_m"], out["gc_m"], out["case"], out["kept_idx"],
             out["num_kept"], out["dwell"], out["has_cands"],
-            out["max_finite"], out["phase_ns"])
+            out["max_finite"], out["phase_ns"], out["dt"])
         from ..utils import metrics
         phase_ns = out["phase_ns"].tolist()
         for name, ns in zip(("candidates", "select", "routes"), phase_ns):

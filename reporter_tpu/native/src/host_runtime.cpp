@@ -985,7 +985,7 @@ extern "C" {
 // numpy path loudly instead of calling through a stale signature. BUMP
 // THIS on ANY change to the signatures below, in the same commit as the
 // Python-side constant.
-int32_t rt_abi_version(void) { return 13; }
+int32_t rt_abi_version(void) { return 14; }
 
 void* rt_graph_create(int64_t n_nodes, int64_t n_edges,
                       const double* node_x, const double* node_y,
@@ -1174,6 +1174,20 @@ void rt_route_matrices(void* handle, int64_t T, int32_t K,
 // BENCH artifact can attribute prep time without a profiler;
 // REPORTER_TPU_PREP_TIMINGS=1 additionally prints one stderr line per
 // call.
+//
+// ABI 14 additions for the device route kernel (graph/route_device.py):
+// ``out_dt`` (B, T) doubles gets the kept-point probe time deltas the
+// route stage would bound against — dt_b[t] = times[kept[t+1]] -
+// times[kept[t]] for t < n-1 when the time bound is armed, -1.0
+// everywhere else — always written, so a skip_routes caller can apply
+// the identical time cap off-host. ``skip_routes`` != 0 skips ONLY the
+// route_step loop (candidates, selection, gc, case codes, dt and the
+// tail fill are unchanged; route rows [0, n-1) are then the caller's to
+// write — the device kernel fills every one of them). ``prune_margin``
+// > 0 arms FLASH-style candidate pruning after selection: each kept
+// row's candidates (sorted ascending by projection distance) are cut
+// where dist > dist[0] + prune_margin, shrinking K before any route is
+// requested; the best candidate always survives.
 void rt_prepare_batch(void* handle, int64_t n_traces, const int64_t* pt_off,
                       const double* lat, const double* lon,
                       const double* times, double lat0, double lon0,
@@ -1182,12 +1196,14 @@ void rt_prepare_batch(void* handle, int64_t n_traces, const int64_t* pt_off,
                       double breakage_distance, double factor,
                       double min_bound, double backward_tol,
                       double time_factor, double min_time_bound,
-                      double turn_penalty_factor, int32_t n_threads,
+                      double turn_penalty_factor, double prune_margin,
+                      int32_t skip_routes, int32_t n_threads,
                       int32_t* out_edge, float* out_dist, float* out_off,
                       float* out_route, float* out_gc, int32_t* out_case,
                       int32_t* out_kept, int32_t* out_num_kept,
                       float* out_dwell, uint8_t* out_has_cands,
-                      float* out_max_finite, int64_t* out_phase_ns) {
+                      float* out_max_finite, int64_t* out_phase_ns,
+                      double* out_dt) {
   auto* g = static_cast<Graph*>(handle);
   // one prepare call at a time per handle: the per-slot scratches and
   // candidate staging buffers below are reused across calls
@@ -1259,6 +1275,7 @@ void rt_prepare_batch(void* handle, int64_t n_traces, const int64_t* pt_off,
     float* gc_b = out_gc + b * T;
     int32_t* case_b = out_case + b * T;
     int32_t* kept_b = out_kept + b * T;
+    double* dt_b = out_dt + b * T;
     out_num_kept[b] = 0;
     out_dwell[b] = 0.0f;
     // pad sentinels for rows beyond the live prefix — written HERE (in
@@ -1282,6 +1299,7 @@ void rt_prepare_batch(void* handle, int64_t n_traces, const int64_t* pt_off,
                   static_cast<int64_t>(T - live_route) * K * K,
                   kUnreachable);
       std::fill_n(gc_b + live_route, T - live_route, 0.0f);
+      std::fill_n(dt_b + live_route, T - live_route, -1.0);
     };
     if (n_raw <= 0) {
       fill_tail(0, 0);
@@ -1371,24 +1389,59 @@ void rt_prepare_batch(void* handle, int64_t n_traces, const int64_t* pt_off,
       }
     }
 
+    // FLASH-style candidate pruning: each kept row is sorted ascending
+    // by projection distance (candidates_for_point), so cutting the
+    // suffix past dist[0] + margin keeps the emission-dominant
+    // candidates and shrinks K before any route is requested. Row 0's
+    // best candidate always survives, so selection is unchanged.
+    if (prune_margin > 0) {
+      for (int32_t t = 0; t < n; ++t) {
+        int32_t* er = edge_b + static_cast<int64_t>(t) * K;
+        float* dr = dist_b + static_cast<int64_t>(t) * K;
+        float* fr = off_b + static_cast<int64_t>(t) * K;
+        if (er[0] == kPadEdge) continue;
+        const float cut = dr[0] + static_cast<float>(prune_margin);
+        for (int32_t q = 1; q < K; ++q) {
+          if (er[q] == kPadEdge) break;
+          if (dr[q] > cut) {
+            for (int32_t w = q; w < K && er[w] != kPadEdge; ++w) {
+              er[w] = kPadEdge;
+              dr[w] = kPadDist;
+              fr[w] = 0.0f;
+            }
+            break;
+          }
+        }
+      }
+    }
+
     if (timings || out_phase_ns) {
       const auto t2 = clk::now();
       ns_select += (t2 - tp).count();
       tp = t2;
     }
-    // route matrices between consecutive kept candidate rows; dt from the
-    // kept points' probe times feeds the time-admissibility bound
+    // kept-point probe time deltas: always recorded (the device route
+    // kernel applies the identical time cap from them); -1 marks steps
+    // the time bound must not arm on
     const bool have_dt = time_factor > 0 && n > 1;
-    for (int32_t t = 0; t + 1 < n; ++t) {
-      const double dt_t =
-          have_dt ? times[p0 + kept[t + 1]] - times[p0 + kept[t]] : 0.0;
-      const float step_max = route_step(
-          g, edge_b + t * K, off_b + t * K, edge_b + (t + 1) * K,
-          off_b + (t + 1) * K, K, gc_b[t], dt_t, have_dt, factor,
-          min_bound, backward_tol, time_factor, min_time_bound,
-          turn_penalty_factor, rscratch,
-          route_b + static_cast<int64_t>(t) * K * K);
-      if (step_max > local_max) local_max = step_max;
+    for (int32_t t = 0; t + 1 < n; ++t)
+      dt_b[t] = have_dt
+                    ? times[p0 + kept[t + 1]] - times[p0 + kept[t]]
+                    : -1.0;
+    // route matrices between consecutive kept candidate rows; dt feeds
+    // the time-admissibility bound. skip_routes leaves rows [0, n-1)
+    // for the device kernel (the tail fill below still covers the rest)
+    if (!skip_routes) {
+      for (int32_t t = 0; t + 1 < n; ++t) {
+        const double dt_t = have_dt ? dt_b[t] : 0.0;
+        const float step_max = route_step(
+            g, edge_b + t * K, off_b + t * K, edge_b + (t + 1) * K,
+            off_b + (t + 1) * K, K, gc_b[t], dt_t, have_dt, factor,
+            min_bound, backward_tol, time_factor, min_time_bound,
+            turn_penalty_factor, rscratch,
+            route_b + static_cast<int64_t>(t) * K * K);
+        if (step_max > local_max) local_max = step_max;
+      }
     }
     fill_tail(n, n - 1);
     bump_max(local_max);

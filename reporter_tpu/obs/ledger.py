@@ -117,6 +117,12 @@ def entry_from_bench(parsed: dict, source: str, label: str, kind: str,
     # ratio pair, gated by perf_gate --min-query-ratio
     if isinstance(parsed.get("query"), dict):
         entry["query"] = parsed["query"]
+    # the route-kernel triple (ISSUE 16): device relax vs host Dijkstra
+    # vs native memo on identical pairs (parity asserted before timing)
+    # — the device/host ratio is the prep_routes speedup the pipelined
+    # shares should reflect
+    if isinstance(parsed.get("routes"), dict):
+        entry["routes"] = parsed["routes"]
     return entry
 
 
@@ -263,10 +269,19 @@ def seed_entries(repo: str) -> List[dict]:
         with open(path, encoding="utf-8") as f:
             d = json.load(f)
         box_note = (d.get("context") or {}).get("box")
+        # same-session drift control (r10+): the prior configuration
+        # re-benched on the same box; perf_gate uses it to tell box
+        # drift from a code regression when the ratio lands below the
+        # cross-box floor
+        ctrl_vs = (d.get("control") or {}).get("vs_baseline") \
+            if isinstance(d.get("control"), dict) else None
         if d.get("parsed"):
-            entries.append(entry_from_bench(
+            e = entry_from_bench(
                 d["parsed"], name, f"dev_{label_n}", "bench_dev",
-                context=box_note))
+                context=box_note)
+            if ctrl_vs is not None:
+                e["control_vs_baseline"] = ctrl_vs
+            entries.append(e)
         ser = d.get("serialized_breakdown") or {}
         parsed = d.get("parsed") or {}
         base = (parsed.get("baseline") or {}).get("traces_per_sec")
@@ -277,7 +292,7 @@ def seed_entries(repo: str) -> List[dict]:
                 shares.pop("report", None)  # pre-PR-4 report scope
             # a handful of checked-in artifacts at seed time, not a
             # serving path
-            entries.append({  # lint: ignore[HP002]
+            se = {  # lint: ignore[HP002]
                 "source": name,
                 "label": f"dev_{label_n}_serialized",
                 "kind": "bench_dev",
@@ -290,7 +305,10 @@ def seed_entries(repo: str) -> List[dict]:
                 "stage_shares": shares,
                 "n_devices": None, "ok": True,
                 "context": box_note,
-            })
+            }
+            if ctrl_vs is not None:
+                se["control_vs_baseline"] = ctrl_vs
+            entries.append(se)
 
     # multichip harness verdicts: {"n_devices", "rc", "ok", ...}
     for path in sorted(glob.glob(os.path.join(repo,

@@ -609,8 +609,33 @@ def snapshot(n_events: int = 64) -> dict:
             "padding_waste": round(1.0 - kept / cells, 6) if cells
             else None},
         "shadow": shadow_stats(),
+        "routes": route_kernel_stats(),
         "queue_depth": max(depths.values(), default=0),
         "queue_depths": depths,
+    }
+
+
+def route_kernel_stats() -> dict:
+    """Device-vs-host route-stage split for ``/profile``: chunks the
+    device kernel served vs chunks that fell back to (or never left)
+    the host Dijkstra path, so a prep_routes regression is attributable
+    at a glance — a sick device shows up as fallback/error counts, a
+    disabled knob as device_chunks == 0."""
+    from ..utils import metrics
+    c = metrics.default.counter
+    return {
+        "device_chunks": c("route.device.chunks"),
+        "device_pairs": c("route.device.pairs"),
+        "device_sources": c("route.device.sources"),
+        "sharded_chunks": c("route.device.sharded_chunks"),
+        "deferred_chunks": c("route.device.deferred_chunks"),
+        "async_dispatch_chunks": c("route.device.async_dispatch_chunks"),
+        "cache_hit_rows": c("route.device.cache_hit_rows"),
+        "cache_miss_rows": c("route.device.cache_miss_rows"),
+        "empty_chunks": c("route.device.empty_chunks"),
+        "fallback_chunks": c("route.device.fallback_chunks"),
+        "circuit_skipped_chunks": c("route.device.circuit_skipped_chunks"),
+        "errors": c("route.device.errors"),
     }
 
 

@@ -472,6 +472,68 @@ class TestDecodeDomain:
         assert snap["matcher.circuit.decode.fallback_chunks"] > 0
 
 
+class TestRouteDeviceDomain:
+    """ISSUE 16: the device route-kernel breaker — a route.device fault
+    re-preps the chunk with host routes, bit-identically."""
+
+    @pytest.fixture(scope="class")
+    def city(self):
+        return _grid_city()
+
+    def test_route_device_fault_falls_back_bit_identical(self, city,
+                                                         monkeypatch):
+        pytest.importorskip("jax")
+        from reporter_tpu import native
+        if not native.available():
+            pytest.skip("native toolchain unavailable")
+        from reporter_tpu.matcher import SegmentMatcher
+        reqs = _reqs(city)
+        want = [_plain(r) for r in SegmentMatcher(net=city)
+                .match_many(reqs)]
+        monkeypatch.setenv("REPORTER_TPU_ROUTE_DEVICE", "1")
+        m = SegmentMatcher(net=city)
+        metrics.default.reset()
+        faults.configure("route.device=error@0")
+        got = [_plain(r) for r in m.match_many(reqs)]
+        faults.clear()
+        assert got == want
+        snap = metrics.default.snapshot()["counters"]
+        assert snap["route.device.errors"] > 0
+        assert snap["route.device.fallback_chunks"] > 0
+        # disarmed, the device path serves the next batch — same bytes
+        after = [_plain(r) for r in m.match_many(reqs)]
+        assert after == want
+
+    def test_open_route_breaker_skips_device_per_chunk(self, city,
+                                                       monkeypatch):
+        """threshold-1 + long cooldown: one device failure opens the
+        route.device breaker; subsequent chunks skip the kernel up
+        front (circuit_skipped_chunks) and still serve host bytes."""
+        pytest.importorskip("jax")
+        from reporter_tpu import native
+        if not native.available():
+            pytest.skip("native toolchain unavailable")
+        from reporter_tpu.matcher import SegmentMatcher
+        monkeypatch.setenv("REPORTER_TPU_CIRCUIT_THRESHOLD", "1")
+        monkeypatch.setenv("REPORTER_TPU_CIRCUIT_COOLDOWN_S", "9999")
+        want_m = SegmentMatcher(net=city)
+        reqs = _reqs(city)
+        want = [_plain(r) for r in want_m.match_many(reqs)]
+        monkeypatch.setenv("REPORTER_TPU_ROUTE_DEVICE", "1")
+        m = SegmentMatcher(net=city)
+        metrics.default.reset()
+        faults.configure("route.device=error#1")
+        got = [_plain(r) for r in m.match_many(reqs)]
+        faults.clear()
+        assert got == want
+        assert m.circuit_route.snapshot()["state"] == "open"
+        assert m.open_domains() == ["route.device"]
+        after = [_plain(r) for r in m.match_many(reqs)]
+        assert after == want
+        snap = metrics.default.snapshot()["counters"]
+        assert snap["route.device.circuit_skipped_chunks"] > 0
+
+
 class TestAssembleDomain:
     """ISSUE 9: assemble degradation — scalar fallback + poisoned-trace
     quarantine that keeps every other trace's bytes unchanged."""
@@ -884,7 +946,8 @@ class TestHealthDegradedBlock:
         assert code == 200
         assert body["degraded"]["open"] == []
         assert set(body["degraded"]["domains"]) == {
-            "native.prep", "decode.dispatch", "matcher.assemble"}
+            "native.prep", "decode.dispatch", "matcher.assemble",
+            "route.device"}
         assert set(body["deadletter"]) == {"tiles", "traces"}
         for _ in range(m.circuit_decode.threshold):
             m.circuit_decode.record_failure()

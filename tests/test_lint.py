@@ -409,10 +409,12 @@ def test_abi_bad_fixture_catches_every_drift_class():
     assert rules == {"ABI001", "ABI002", "ABI003", "ABI004", "ABI005"}
 
 
-def test_abi_live_pair_validates_at_version_13():
+def test_abi_live_pair_validates_at_version_14():
+    # ABI 14: rt_prepare_batch gains prune_margin/skip_routes scalars and
+    # the dt output tensor (ISSUE 16) — same export set, new signature
     cpp = _read(LIVE_CPP)
     exports, version = abi.parse_cpp(cpp)
-    assert version == 13
+    assert version == 14
     assert "rt_prepare_batch" in exports and "rt_assemble_batch" in exports
     # the ABI-13 route-memo profile surface (export + pre-warm)
     assert "rt_route_memo_export" in exports \

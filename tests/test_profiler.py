@@ -539,6 +539,62 @@ class TestPerfGate:
         assert not passed
         assert verdict["failures"][0]["check"] == "ratio"
 
+    def test_drift_control_rescues_below_floor_ratio(self, ledger_mod,
+                                                     gate_mod):
+        """A candidate below the cross-box floor passes the ratio check
+        iff its same-box control is ALSO below the floor (the box
+        provably can't reach the median) and the candidate is within
+        tolerance of the control."""
+        entries = self._entries(ledger_mod)
+        import statistics
+        median = statistics.median(
+            e["vs_baseline"] for e in gate_mod.comparable_pool(
+                entries, "cpu", "full"))
+        low = round(median * 0.8, 2)
+        cand = {"source": "c", "platform": "cpu", "scope": "full",
+                "vs_baseline": low, "pipelined": False,
+                "stage_shares": None, "kind": "bench",
+                "control_vs_baseline": round(median * 0.78, 2)}
+        passed, verdict = gate_mod.gate(cand, entries, 0.15, 0.2, False)
+        assert passed, verdict
+        assert "ratio_drift_control" in verdict
+
+    def test_drift_control_no_leniency_on_healthy_box(self, ledger_mod,
+                                                      gate_mod):
+        """A control at/above the floor proves the box is fine — the
+        slow candidate is a code regression and still fails."""
+        entries = self._entries(ledger_mod)
+        import statistics
+        median = statistics.median(
+            e["vs_baseline"] for e in gate_mod.comparable_pool(
+                entries, "cpu", "full"))
+        cand = {"source": "c", "platform": "cpu", "scope": "full",
+                "vs_baseline": round(median * 0.8, 2),
+                "pipelined": False, "stage_shares": None,
+                "kind": "bench",
+                "control_vs_baseline": round(median * 1.0, 2)}
+        passed, verdict = gate_mod.gate(cand, entries, 0.15, 0.2, False)
+        assert not passed
+        assert verdict["failures"][0]["check"] == "ratio"
+
+    def test_drift_control_bounds_the_regression(self, ledger_mod,
+                                                 gate_mod):
+        """Even on a drifted box the candidate must stay within
+        tolerance of the control — drift never hides a real loss."""
+        entries = self._entries(ledger_mod)
+        import statistics
+        median = statistics.median(
+            e["vs_baseline"] for e in gate_mod.comparable_pool(
+                entries, "cpu", "full"))
+        cand = {"source": "c", "platform": "cpu", "scope": "full",
+                "vs_baseline": round(median * 0.5, 2),
+                "pipelined": False, "stage_shares": None,
+                "kind": "bench",
+                "control_vs_baseline": round(median * 0.8, 2)}
+        passed, verdict = gate_mod.gate(cand, entries, 0.15, 0.2, False)
+        assert not passed
+        assert verdict["failures"][0]["check"] == "ratio"
+
     def test_grown_stage_share_fails(self, ledger_mod, gate_mod):
         entries = self._entries(ledger_mod)
         cand = {"source": "c", "platform": "cpu", "scope": "full",

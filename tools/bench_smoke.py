@@ -22,7 +22,7 @@ import subprocess
 import sys
 
 REQUIRED_TOP = ("metric", "value", "unit", "vs_baseline", "stages",
-                "report_writers", "baseline", "probe", "query")
+                "report_writers", "baseline", "probe", "query", "routes")
 REQUIRED_STAGES = ("prep", "decode_dispatch", "decode_wait", "assemble",
                    "report", "total", "prep_share", "report_share",
                    "pipelined")
@@ -116,6 +116,18 @@ def main(argv=None) -> int:
         sys.stderr.write(
             f"bench smoke: query.batch_ratio missing: {query}\n")
         return 1
+    # the route-kernel triple (ISSUE 16): device relax vs host Dijkstra
+    # vs native memo on identical pairs — the leg asserts byte-parity
+    # BEFORE timing, so a measured ratio implies parity held; it needs
+    # the native prep tensors, so native-less boxes see a skip record
+    routes = art.get("routes") or {}
+    if native_ok:
+        if routes.get("parity") != "byte-identical" or \
+                not isinstance(routes.get("device_vs_native"),
+                               (int, float)):
+            sys.stderr.write(
+                f"bench smoke: routes leg broken: {routes}\n")
+            return 1
     if args.out:
         with open(args.out, "w", encoding="utf-8") as f:
             json.dump(art, f)

@@ -15,6 +15,15 @@ Comparable = same ``platform``, a recorded ratio, and (for the share
 check) the same ``pipelined`` flag — pipelined stage seconds overlap
 the wall, so shares are only meaningful against like-pipelined runs.
 
+Box drift containment: even ratios drift between box draws (the
+committed history spans 13.9-27.8 on the same code lineage). An
+artifact may therefore carry a same-session ``control`` run — the
+prior configuration re-benched on the SAME box. A candidate below the
+cross-box floor still passes the ratio check iff the control is ALSO
+below the floor (the box provably can't reach the median that day) and
+the candidate is within ``--tolerance`` of the control. A healthy box
+gets no leniency, and shares/padding/query gates are never relaxed.
+
 Usage:
     # gate a fresh bench artifact (e.g. bench_smoke --out) against the
     # committed ledger
@@ -111,16 +120,39 @@ def gate(candidate: dict, entries: List[dict], tolerance: float,
     verdict["median_vs_baseline"] = round(median, 3)
     verdict["floor"] = round(floor, 3)
     cand_vs = candidate.get("vs_baseline")
+    # same-box drift control (BENCH artifacts carry it as a "control"
+    # block; ledger entries as control_vs_baseline): the PRIOR
+    # configuration re-benched in the same session. When the control
+    # itself lands below the cross-box floor the box demonstrably
+    # cannot reach the ledger median that day — ratios drift ~2x
+    # between box draws just like absolutes (r10 measured it) — so the
+    # binding comparison becomes candidate-vs-control on the SAME box.
+    # A healthy box (control at/above floor) gets no such leniency.
+    ctrl = candidate.get("control_vs_baseline")
+    if ctrl is None and isinstance(candidate.get("control"), dict):
+        ctrl = candidate["control"].get("vs_baseline")
     if cand_vs is None:
         verdict["failures"].append(
             {"check": "ratio", "reason": "candidate has no vs_baseline "
              "(failed run?)"})
     elif cand_vs < floor:
-        verdict["failures"].append(
-            {"check": "ratio", "candidate": cand_vs,
-             "median": round(median, 3), "floor": round(floor, 3),
-             "reason": f"vs_baseline {cand_vs} fell more than "
-             f"{tolerance:.0%} below the ledger median {median:.2f}"})
+        if ctrl is not None and ctrl < floor \
+                and cand_vs >= ctrl * (1.0 - tolerance):
+            verdict["ratio_drift_control"] = {
+                "control_vs_baseline": ctrl,
+                "control_floor": round(ctrl * (1.0 - tolerance), 3),
+                "note": (f"vs_baseline {cand_vs} is below the cross-box "
+                         f"floor {floor:.2f}, but the same-box control "
+                         f"run only reached {ctrl} — box drift, not a "
+                         "code regression; gated against the control "
+                         "instead"),
+            }
+        else:
+            verdict["failures"].append(
+                {"check": "ratio", "candidate": cand_vs,
+                 "median": round(median, 3), "floor": round(floor, 3),
+                 "reason": f"vs_baseline {cand_vs} fell more than "
+                 f"{tolerance:.0%} below the ledger median {median:.2f}"})
 
     shares = candidate.get("stage_shares")
     pipelined = candidate.get("pipelined")

@@ -20,10 +20,12 @@ set -u
 ROOT="$(cd "$(dirname "$0")/.." && pwd)"
 NATIVE="$ROOT/reporter_tpu/native"
 CXX="${CXX:-g++}"
-TESTS="tests/test_native.py tests/test_native_batch.py tests/test_prep_v2.py tests/test_report_writer.py"
+TESTS="tests/test_native.py tests/test_native_batch.py tests/test_prep_v2.py tests/test_report_writer.py tests/test_route_device.py"
 # test_report_writer drives the ABI-12 wire writers (per-trace +
 # whole-chunk emission, parity + slicing) under the sanitizer
-# builds with the same 2-thread prep pool
+# builds with the same 2-thread prep pool; test_route_device drives
+# the ABI-14 additions (skip_routes, candidate pruning, dt output)
+# plus the device-vs-host route parity under the instrumented builds
 MODE="${1:-default}"
 
 probe() {
