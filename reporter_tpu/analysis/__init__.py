@@ -1,9 +1,9 @@
 """reporter-lint: project-native static analysis for the framework.
 
-Eight AST-based passes pin the invariants the codebase depends on but no
-general-purpose tool can see — four intra-module syntactic passes (PR 2)
-and four cross-layer contract passes against the declarative registry
-(:mod:`registry`, PR 6):
+Eleven AST-based passes pin the invariants the codebase depends on but
+no general-purpose tool can see — four intra-module syntactic passes
+(PR 2) and seven cross-layer contract passes against the declarative
+registry (:mod:`registry`, PR 6):
 
   hotpath         HP001-HP003   the columnar host pipeline stays columnar
   jit_hygiene     JH001-JH003   jitted regions stay device-pure
@@ -17,6 +17,18 @@ and four cross-layer contract passes against the declarative registry
                   MT001-MT002   metric names: call sites <-> registry
   fault_coverage  FP001-FP003   failpoint sites: registered, hooked,
                                 and chaos/test-exercised
+  tensorcontract  TC001-TC004   kernel shape/dtype signatures match the
+                                committed tools/kernel_contracts.json;
+                                every jit entry contracted; no weak-
+                                scalar dtype hazards; statics stay
+                                non-array
+  placement       DP001-DP003   device lanes materialise host values
+                                only at registry SYNC_POINTS; no d2h
+                                round trips in loops; no numpy handed
+                                to jit entries on device paths
+  fallback        FB001-FB003   every circuit-broken dual path has a
+                                FALLBACK_PAIRS entry with fault site,
+                                kill switch and live parity test
 
 Driver: ``python tools/lint.py`` (CI ``lint`` stage; ``--abi-only`` is
 the pre-commit ABI guard, ``--contracts-only`` the fast cross-layer
@@ -27,14 +39,19 @@ README "Static analysis" for the rule catalogue and workflow.
 
 This package imports nothing heavy (no jax, no numpy at analysis time
 beyond the stdlib ``ast``) so the lint stage starts fast and runs on
-hosts with no accelerator stack.
+hosts with no accelerator stack. The one exception is deliberate and
+lazy: tensorcontract's TC001 eval_shape harness imports jax *inside*
+``compute_signatures()`` (CPU backend, abstract evaluation only — no
+device needed) and records its wall time in ``LAST_EVAL_SECONDS`` so
+the lint stage's budget stays visible.
 """
 from __future__ import annotations
 
 from typing import Dict, List, Optional, Sequence
 
-from . import (abi, durability, fault_coverage, hotpath, jit_hygiene,
-               lockgraph, locks, racecheck, registry, registry_drift)
+from . import (abi, durability, fallback, fault_coverage, hotpath,
+               jit_hygiene, lockgraph, locks, placement, racecheck,
+               registry, registry_drift, tensorcontract)
 from .core import (Finding, SourceFile, collect_py_files, compare_baseline,
                    filter_suppressed, load_baseline)
 
@@ -46,7 +63,8 @@ CODE_PASSES = (hotpath, jit_hygiene, locks, lockgraph, durability)
 #: cross-layer contract passes needing the WHOLE package (plus README /
 #: chaos / fault tests) in view — their reverse directions (dead
 #: entries, doc drift, coverage) would false-fire on a subset.
-CONTRACT_PASSES = (registry_drift, fault_coverage)
+CONTRACT_PASSES = (registry_drift, fault_coverage, tensorcontract,
+                   placement, fallback)
 
 ALL_RULES: Dict[str, str] = {}
 # racecheck's RC rules are runtime findings (the lock witness / guarded
@@ -81,4 +99,5 @@ __all__ = ["Finding", "SourceFile", "collect_py_files", "load_baseline",
            "run_contract_passes", "CODE_PASSES", "CONTRACT_PASSES",
            "ALL_RULES", "abi", "hotpath", "jit_hygiene", "locks",
            "lockgraph", "durability", "registry", "registry_drift",
-           "fault_coverage", "racecheck"]
+           "fault_coverage", "tensorcontract", "placement", "fallback",
+           "racecheck"]

@@ -1,0 +1,191 @@
+"""Fallback-parity pass (FB rules): every device path has a proven twin.
+
+PRs 5/9/11/15 each re-invented the same convention by hand: a fast path
+(device decode, native wire writer, device route costs, native prep)
+is only shippable because a byte-identical fallback sits behind a
+circuit breaker and a kill-switch knob, and a parity test proves the
+two legs agree. Nothing enforced the convention — a fifth dual path
+could ship with a breaker but no knob, or a knob but no parity test,
+and the first time anyone noticed would be mid-incident with the
+fallback silently diverged.
+
+``registry.FALLBACK_PAIRS`` makes the convention a contract: one entry
+per circuit domain, each declaring the fault site that exercises the
+fallback, the kill-switch knob that forces it, and the parity test
+that proves it. This pass closes the loop in both directions:
+
+FB001  a ``CircuitBreaker("<domain>", ...)`` constructed in the
+       package with no FALLBACK_PAIRS entry for its domain — a dual
+       path shipping without the full parity kit.  (A breaker that
+       guards quarantine/shedding rather than a dual implementation is
+       a deliberate exception: suppress with ``# lint: ignore[FB001]``
+       and say why.)
+FB002  a registry pair missing a leg (fault_site / knob / parity_test),
+       naming a fault site or knob the registry doesn't know, or —
+       reverse direction — declaring a domain no breaker in the
+       package constructs.
+FB003  a parity-test reference pointing at a file that doesn't exist
+       or a test name the file doesn't contain — a dangling proof is
+       no proof.
+
+FB002's reverse direction and FB003's filesystem checks judge the
+registry against the whole package and run only under
+``full_scope=True`` (skipped by partial path runs, same as the other
+contract passes).
+"""
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Mapping, Optional, Sequence
+
+from . import registry
+from .core import Finding, SourceFile, terminal_name
+
+RULES = {
+    "FB001": "circuit breaker domain with no FALLBACK_PAIRS entry",
+    "FB002": "FALLBACK_PAIRS entry missing or mis-declaring a leg",
+    "FB003": "dangling parity-test reference in FALLBACK_PAIRS",
+}
+
+REGISTRY_REL = "reporter_tpu/analysis/registry.py"
+
+#: the breaker class's own module — constructions there are the class
+#: definition/docstring examples, not real domains
+_EXCLUDE_RELS = frozenset({"reporter_tpu/utils/circuit.py", REGISTRY_REL})
+
+#: the three legs every pair must declare (the domain itself is the key)
+_LEGS = ("fault_site", "knob", "parity_test")
+
+
+def _registry_lines(repo_root: str) -> Dict[str, int]:
+    """First-occurrence line of each string constant in registry.py —
+    lets registry-side findings point at the real entry."""
+    path = os.path.join(repo_root, REGISTRY_REL)
+    out: Dict[str, int] = {}
+    try:
+        with open(path, encoding="utf-8") as f:
+            tree = ast.parse(f.read())
+    except (OSError, SyntaxError):
+        return out
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            out.setdefault(node.value, node.lineno)
+    return out
+
+
+def _breaker_sites(files: Sequence[SourceFile]) -> List[tuple]:
+    """(domain, relpath, lineno) for every literal-domain
+    ``CircuitBreaker("...")`` construction in view."""
+    sites = []
+    for sf in files:
+        if sf.relpath in _EXCLUDE_RELS:
+            continue
+        for node in ast.walk(sf.tree):
+            if not (isinstance(node, ast.Call)
+                    and terminal_name(node.func) == "CircuitBreaker"
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                continue
+            sites.append((node.args[0].value, sf.relpath, node.lineno))
+    return sites
+
+
+def run(files: Sequence[SourceFile], repo_root: str,
+        pairs: Optional[Mapping[str, Mapping[str, str]]] = None,
+        test_texts: Optional[Mapping[str, str]] = None,
+        full_scope: bool = True) -> List[Finding]:
+    """``pairs``/``test_texts`` are injectable for tests: ``test_texts``
+    maps a parity-test file's repo-relative path to its text (default:
+    read from ``repo_root``)."""
+    if pairs is None:
+        pairs = registry.FALLBACK_PAIRS
+    findings: List[Finding] = []
+    reg_lines = _registry_lines(repo_root)
+    sites = _breaker_sites(files)
+
+    # FB001: constructed breaker domain without a registry pair
+    for domain, relpath, lineno in sites:
+        if domain not in pairs:
+            findings.append(Finding(
+                relpath, lineno, "FB001",
+                f"circuit domain {domain!r} has no registry."
+                "FALLBACK_PAIRS entry — a dual path needs a declared "
+                "fault site, kill-switch knob and parity test (or a "
+                "justified suppression if this breaker guards no dual "
+                "implementation)"))
+
+    # FB002 forward: each pair must carry all three legs, and the legs
+    # must resolve against the registry's own tables
+    for domain in sorted(pairs):
+        legs = pairs[domain]
+        line = reg_lines.get(domain, 1)
+        for leg in _LEGS:
+            if not legs.get(leg):
+                findings.append(Finding(
+                    REGISTRY_REL, line, "FB002",
+                    f"FALLBACK_PAIRS[{domain!r}] is missing the "
+                    f"{leg!r} leg — the pair is not a full parity "
+                    "contract without it"))
+        fault_site = legs.get("fault_site")
+        if fault_site and fault_site not in registry.FAULT_SITES:
+            findings.append(Finding(
+                REGISTRY_REL, reg_lines.get(fault_site, line), "FB002",
+                f"FALLBACK_PAIRS[{domain!r}] names fault site "
+                f"{fault_site!r} which is not in registry.FAULT_SITES "
+                "— the fallback leg cannot be fault-injected"))
+        knob = legs.get("knob")
+        if knob and knob not in registry.ENV_KNOBS:
+            findings.append(Finding(
+                REGISTRY_REL, reg_lines.get(knob, line), "FB002",
+                f"FALLBACK_PAIRS[{domain!r}] names kill switch "
+                f"{knob!r} which is not in registry.ENV_KNOBS — an "
+                "undocumented knob is not an operable kill switch"))
+
+    if not full_scope:
+        return findings
+
+    # FB002 reverse: a registered pair whose domain no breaker in the
+    # package constructs — a contract for a path that does not exist
+    constructed = {domain for domain, _, _ in sites}
+    for domain in sorted(set(pairs) - constructed):
+        findings.append(Finding(
+            REGISTRY_REL, reg_lines.get(domain, 1), "FB002",
+            f"FALLBACK_PAIRS[{domain!r}] matches no CircuitBreaker "
+            "construction in the package — dead pair entries hide "
+            "real coverage gaps"))
+
+    # FB003: the parity-test reference must point at a real file and a
+    # name that file actually contains
+    for domain in sorted(pairs):
+        ref = pairs[domain].get("parity_test")
+        if not ref:
+            continue  # already an FB002
+        line = reg_lines.get(ref, reg_lines.get(domain, 1))
+        relpath, _, name = ref.partition("::")
+        if test_texts is not None:
+            text = test_texts.get(relpath)
+        else:
+            try:
+                with open(os.path.join(repo_root, relpath),
+                          encoding="utf-8") as f:
+                    text = f.read()
+            except OSError:
+                text = None
+        if text is None:
+            findings.append(Finding(
+                REGISTRY_REL, line, "FB003",
+                f"FALLBACK_PAIRS[{domain!r}] parity test {ref!r} "
+                "points at a file that does not exist"))
+            continue
+        missing = [part for part in name.split("::")
+                   if part and part not in text]
+        if not name or missing:
+            findings.append(Finding(
+                REGISTRY_REL, line, "FB003",
+                f"FALLBACK_PAIRS[{domain!r}] parity test {ref!r} "
+                f"names {missing[0] if missing else '(nothing)'!r} "
+                f"which {relpath} does not define — a dangling proof "
+                "is no proof"))
+    return findings

@@ -1,0 +1,349 @@
+"""Transfer-discipline pass (DP rules): host<->device placement.
+
+The pipelined matcher (PR 12) and the device route kernel (PR 15) built
+their throughput on one discipline: device values cross back to the
+host at exactly two declared points — the drain-lane d2h gather and the
+deferred-route resolve at wire time. Any other materialisation
+(``np.asarray``/``.item()``/``float()``/``np.array`` on a device array)
+is a hidden synchronisation: the submitting thread blocks on the device
+queue, the overlap the lanes exist for collapses, and nothing crashes —
+the bench just quietly loses its pipelining. Exa.TrkX's acceleration
+writeups (PAPERS.md) call the transfer points exactly where pipelined
+throughput silently dies; this pass makes them a lint-visible contract.
+
+The pass walks the package call graph from the ``registry.DEVICE_LANES``
+entry points (the lane roots are registry-declared because the real
+submits go through the ``_lane_stage`` indirection, which structural
+pool-root detection cannot see), resolving calls like lockgraph does
+(same-class -> same-module -> package-wide-unique, stdlib protocol
+names barred) and *stopping* at ``registry.SYNC_POINTS`` — the
+whitelisted materialisation sites. Device values are tracked as locals
+assigned from kernel-entry calls (the ``KERNEL_CONTRACTS`` entries plus
+the ``decode_batch`` facade), closed over functions that return them.
+
+DP001  host materialisation of a device value reachable from a device
+       lane outside the SYNC_POINTS whitelist (also: a SYNC_POINTS
+       entry naming no existing function — a dangling whitelist is a
+       hole, not a contract).
+DP002  the same materialisation inside a loop that also dispatches
+       device work: a device<->host round trip per iteration, the
+       worst version of the bug.
+DP003  a declared device-resident path handing a bare numpy array to a
+       jit entry (implicit h2d per call; wrap at the boundary with
+       ``jnp.asarray``/``device_put``) — also flags a dangling
+       DEVICE_LANES entry.
+
+Known approximations (err toward silence, suppressions are the escape
+hatch): values are tracked per-function through direct call assignment
+only — attribute loads, container round-trips and cross-function
+argument flow are not followed; ``bool()``/``int()`` casts are NOT
+sinks (the route kernel's convergence check ``bool(converged)`` is a
+deliberate, circuit-visible sync).
+"""
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from . import registry
+from .core import Finding, SourceFile, dotted, terminal_name
+from .jit_hygiene import _Module
+from .lockgraph import _Resolver, _callback_name
+
+RULES = {
+    "DP001": "host materialisation of a device value outside SYNC_POINTS",
+    "DP002": "device<->host round trip inside a dispatching loop",
+    "DP003": "numpy array handed to a jit entry on a device-resident path",
+}
+
+REGISTRY_REL = "reporter_tpu/analysis/registry.py"
+
+#: contract-key terminal names too generic to treat as producers by
+#: bare-name matching (a passed-in ``kernel`` wrapper, a pallas body)
+_GENERIC_ENTRIES = frozenset({"kernel", "_forward_kernel"})
+#: materialisation sinks, exactly the ISSUE 17 set — bool()/int() are
+#: deliberate scalar syncs (convergence checks) and stay legal
+_NP_SINKS = frozenset({"asarray", "array"})
+
+
+def _default_entry_names() -> Set[str]:
+    names = {k.split("::")[1] for k in registry.KERNEL_CONTRACTS}
+    return (names - _GENERIC_ENTRIES) | {"decode_batch"}
+
+
+class _Fn:
+    """One module- or class-level function (duck-typed for lockgraph's
+    _Resolver: key / relpath / cls / local_names)."""
+
+    __slots__ = ("key", "relpath", "cls", "local_names", "calls", "node")
+
+    def __init__(self, key: str, relpath: str, cls: Optional[str],
+                 node: ast.AST):
+        self.key = key
+        self.relpath = relpath
+        self.cls = cls
+        self.node = node
+        self.local_names: Set[str] = set()
+        self.calls: Set[str] = set()
+        for n in ast.walk(node):
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and n is not node:
+                self.local_names.add(n.name)
+            elif isinstance(n, ast.Call):
+                leaf = terminal_name(n.func)
+                if leaf is not None:
+                    self.calls.add(leaf)
+                cb = _callback_name(n)
+                if cb is not None:
+                    self.calls.add(cb)
+
+    @property
+    def spec(self) -> str:
+        return f"{self.relpath}::{self.key}"
+
+
+def _collect(files: Sequence[SourceFile]) -> Dict[str, Dict[str, _Fn]]:
+    by_file: Dict[str, Dict[str, _Fn]] = {}
+    for sf in files:
+        funcs: Dict[str, _Fn] = {}
+        for node in sf.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                funcs[node.name] = _Fn(node.name, sf.relpath, None, node)
+            elif isinstance(node, ast.ClassDef):
+                for sub in node.body:
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        key = f"{node.name}.{sub.name}"
+                        funcs[key] = _Fn(key, sf.relpath, node.name, sub)
+        by_file[sf.relpath] = funcs
+    return by_file
+
+
+def _assigned_from(node: ast.AST, names: Set[str]) -> Set[str]:
+    """Locals assigned (incl. tuple-unpacked) from a call whose terminal
+    name is in ``names``."""
+    out: Set[str] = set()
+    for n in ast.walk(node):
+        if not (isinstance(n, ast.Assign)
+                and isinstance(n.value, ast.Call)):
+            continue
+        leaf = terminal_name(n.value.func)
+        if leaf not in names:
+            continue
+        for t in n.targets:
+            if isinstance(t, ast.Name):
+                out.add(t.id)
+            elif isinstance(t, (ast.Tuple, ast.List)):
+                out.update(e.id for e in t.elts
+                           if isinstance(e, ast.Name))
+    return out
+
+
+def _np_locals(fn: _Fn, np_roots: Set[str]) -> Set[str]:
+    """Locals assigned from any ``np.*`` call — host arrays."""
+    out: Set[str] = set()
+    for n in ast.walk(fn.node):
+        if not (isinstance(n, ast.Assign)
+                and isinstance(n.value, ast.Call)):
+            continue
+        d = dotted(n.value.func)
+        if d is None or d.split(".")[0] not in np_roots | {"numpy"}:
+            continue
+        for t in n.targets:
+            if isinstance(t, ast.Name):
+                out.add(t.id)
+            elif isinstance(t, (ast.Tuple, ast.List)):
+                out.update(e.id for e in t.elts
+                           if isinstance(e, ast.Name))
+    return out
+
+
+def _producers(by_file: Dict[str, Dict[str, _Fn]],
+               seed: Set[str]) -> Set[str]:
+    """Fixpoint: a function returning a device-tracked local becomes a
+    producer under its bare name (``_relax``/``_run`` close over the
+    kernel calls they wrap)."""
+    producers = set(seed)
+    changed = True
+    while changed:
+        changed = False
+        for funcs in by_file.values():
+            for fn in funcs.values():
+                bare = fn.key.rsplit(".", 1)[-1]
+                if bare in producers:
+                    continue
+                dev = _assigned_from(fn.node, producers)
+                if not dev:
+                    continue
+                for n in ast.walk(fn.node):
+                    if isinstance(n, ast.Return) and n.value is not None \
+                            and any(isinstance(c, ast.Name)
+                                    and c.id in dev
+                                    for c in ast.walk(n.value)):
+                        producers.add(bare)
+                        changed = True
+                        break
+    return producers
+
+
+def _first_mention(expr: ast.AST, names: Set[str]) -> Optional[str]:
+    for n in ast.walk(expr):
+        if isinstance(n, ast.Name) and n.id in names:
+            return n.id
+    return None
+
+
+class _LaneScan(ast.NodeVisitor):
+    """DP sinks inside one lane-reachable function."""
+
+    def __init__(self, fn: _Fn, np_roots: Set[str], device: Set[str],
+                 host: Set[str], producers: Set[str],
+                 entry_names: Set[str]):
+        self.fn = fn
+        self.np_roots = np_roots | {"numpy"}
+        self.device = device
+        self.host = host
+        self.producers = producers
+        self.entry_names = entry_names
+        self.loops: List[bool] = []  # per enclosing loop: dispatches?
+        self.findings: List[Finding] = []
+
+    def _emit(self, node: ast.AST, desc: str, name: str) -> None:
+        if any(self.loops):
+            self.findings.append(Finding(
+                self.fn.relpath, node.lineno, "DP002",
+                f"{desc} of device value {name!r} inside a loop that "
+                "also dispatches device work — a device<->host round "
+                "trip per iteration"))
+        else:
+            self.findings.append(Finding(
+                self.fn.relpath, node.lineno, "DP001",
+                f"{desc} of device value {name!r} on a device lane "
+                "outside registry.SYNC_POINTS — a hidden sync "
+                "serialises the pipeline (route it through a declared "
+                "sync point)"))
+
+    def _loop(self, node) -> None:
+        dispatches = any(isinstance(n, ast.Call)
+                         and terminal_name(n.func) in self.producers
+                         for n in ast.walk(node))
+        self.loops.append(dispatches)
+        self.generic_visit(node)
+        self.loops.pop()
+
+    visit_For = _loop
+    visit_While = _loop
+    visit_AsyncFor = _loop  # type: ignore[assignment]
+
+    def visit_Call(self, node: ast.Call) -> None:
+        d = dotted(node.func)
+        leaf = terminal_name(node.func)
+        if isinstance(node.func, ast.Name) and node.func.id == "float" \
+                and node.args:
+            name = _first_mention(node.args[0], self.device)
+            if name is not None:
+                self._emit(node, "float() cast", name)
+        elif d is not None and d.split(".")[0] in self.np_roots \
+                and d.split(".")[-1] in _NP_SINKS and node.args:
+            name = None
+            for a in node.args:
+                name = _first_mention(a, self.device)
+                if name is not None:
+                    break
+            if name is not None:
+                self._emit(node, f"{d}()", name)
+        elif isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "item" and not node.args:
+            name = _first_mention(node.func.value, self.device)
+            if name is not None:
+                self._emit(node, ".item()", name)
+        if leaf in self.entry_names:
+            for a in node.args:
+                if isinstance(a, ast.Name) and a.id in self.host:
+                    self.findings.append(Finding(
+                        self.fn.relpath, node.lineno, "DP003",
+                        f"numpy array {a.id!r} handed straight to jit "
+                        f"entry {leaf}() on a device-resident path — "
+                        "an implicit h2d transfer per call; wrap it in "
+                        "jnp.asarray/device_put at the boundary"))
+        self.generic_visit(node)
+
+
+def _registry_lines(repo_root: str) -> Dict[str, int]:
+    path = os.path.join(repo_root, REGISTRY_REL)
+    out: Dict[str, int] = {}
+    try:
+        with open(path, encoding="utf-8") as f:
+            tree = ast.parse(f.read())
+    except (OSError, SyntaxError):
+        return out
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            out.setdefault(node.value, node.lineno)
+    return out
+
+
+def run(files: Sequence[SourceFile], repo_root: str,
+        lanes: Optional[Sequence[str]] = None,
+        sync_points: Optional[Sequence[str]] = None,
+        entry_names: Optional[Set[str]] = None,
+        full_scope: bool = True) -> List[Finding]:
+    """``full_scope=False`` (partial/fixture runs) skips the dangling
+    DEVICE_LANES/SYNC_POINTS reverse checks — those judge the registry
+    against the whole package."""
+    lanes = list(registry.DEVICE_LANES if lanes is None else lanes)
+    sync = set(registry.SYNC_POINTS if sync_points is None
+               else sync_points)
+    entries = _default_entry_names() if entry_names is None \
+        else set(entry_names)
+
+    by_file = _collect(files)
+    np_roots_by_rel = {sf.relpath: _Module(sf).alias_roots("numpy")
+                       for sf in files}
+    resolver = _Resolver(by_file)
+    producers = _producers(by_file, entries)
+    findings: List[Finding] = []
+    reg_lines = _registry_lines(repo_root)
+
+    all_specs = {fn.spec for funcs in by_file.values()
+                 for fn in funcs.values()}
+    if full_scope:
+        for spec in sorted(set(lanes) - all_specs):
+            findings.append(Finding(
+                REGISTRY_REL, reg_lines.get(spec, 1), "DP003",
+                f"DEVICE_LANES entry {spec} names no module- or class-"
+                "level function — a dangling lane root walks nothing"))
+        for spec in sorted(sync - all_specs):
+            findings.append(Finding(
+                REGISTRY_REL, reg_lines.get(spec, 1), "DP001",
+                f"SYNC_POINTS entry {spec} names no module- or class-"
+                "level function — a dangling whitelist entry is a hole"))
+
+    # BFS over the call graph from the lane roots, stopping at the
+    # whitelisted sync points
+    roots: List[_Fn] = []
+    for spec in lanes:
+        relpath, key = spec.split("::", 1)
+        fn = by_file.get(relpath, {}).get(key)
+        if fn is not None:
+            roots.append(fn)
+    seen: Set[str] = set()
+    work = [fn for fn in roots if fn.spec not in sync]
+    while work:
+        fn = work.pop()
+        if fn.spec in seen:
+            continue
+        seen.add(fn.spec)
+        np_roots = np_roots_by_rel.get(fn.relpath, set())
+        device = _assigned_from(fn.node, producers)
+        host = _np_locals(fn, np_roots)
+        scan = _LaneScan(fn, np_roots, device, host, producers, entries)
+        scan.visit(fn.node)
+        findings.extend(scan.findings)
+        for name in sorted(fn.calls):
+            callee = resolver.resolve(fn, name)
+            if callee is not None and callee.spec not in sync \
+                    and callee.spec not in seen:
+                work.append(callee)
+    return findings

@@ -60,6 +60,7 @@ ENV_KNOBS: Dict[str, str] = {
     "REPORTER_TPU_STORE_LEASE_S": "cross-process writer-lease TTL (0 off)",
     "REPORTER_TPU_COMPACT_INTERVAL_S": "background compactor pace (s)",
     "REPORTER_TPU_CITY_BUDGET_MB": "multi-city residency LRU byte budget",
+    "REPORTER_TPU_NATIVE": "C++ host runtime: auto|off (prep kill switch)",
     "REPORTER_TPU_NATIVE_LIB": "prebuilt .so override (sanitizers/CI)",
     "REPORTER_TPU_FAULTS": "deterministic failpoint spec",
     "REPORTER_TPU_CIRCUIT_THRESHOLD": "errors that open the breaker",
@@ -268,5 +269,99 @@ EPOCH_COMMIT_CONTRACTS: Dict[str, Tuple[str, str]] = {
         ("punctuate", "commit_epoch"),
 }
 
+# ---- kernel contracts (TC rules) -------------------------------------------
+# "relpath::function" for every jax.jit / pallas_call entry point the
+# jit_hygiene enumerator finds. Two-sided with the code (tensorcontract
+# TC002): an entry here with no jit region is dead, a jit entry missing
+# here is uncontracted. The abstract shape/dtype signatures themselves
+# live in tools/kernel_contracts.json (regenerated by
+# ``python -m reporter_tpu.analysis.tensorcontract --write``); entries
+# the eval harness cannot drive stand-alone (a passed-in kernel wrapper,
+# a pallas kernel body) are covered through their callers and carry no
+# JSON cases.
+KERNEL_CONTRACTS: Dict[str, str] = {
+    "reporter_tpu/ops/route_relax.py::relax_csr":
+        "multi-source bounded relaxation -> (S,N) dist/time kernels",
+    "reporter_tpu/ops/route_relax.py::pair_costs":
+        "route-tensor assembly -> (B,T-1,K,K) costs + max_finite",
+    "reporter_tpu/ops/route_relax.py::pair_costs_packed":
+        "pair_costs behind two packed h2d blobs (warm dispatch)",
+    "reporter_tpu/ops/assoc_viterbi.py::viterbi_assoc_batch":
+        "associative-scan decode -> (B,T) paths + (B,) scores",
+    "reporter_tpu/ops/pallas_viterbi.py::viterbi_pallas_batch":
+        "pallas fused decode -> (B,T) paths + (B,) scores",
+    "reporter_tpu/ops/pallas_viterbi.py::_forward_kernel":
+        "pallas kernel body (covered via viterbi_pallas_batch; no "
+        "stand-alone eval cases)",
+    "reporter_tpu/matcher/hmm.py::viterbi_decode_batch":
+        "scan decode -> (B,T) paths + (B,) scores (the oracle twin)",
+    "reporter_tpu/parallel/sharded.py::kernel":
+        "sharded wrapper over a passed-in decode kernel (signature "
+        "owned by the wrapped entry; no stand-alone eval cases)",
+    "reporter_tpu/parallel/sharded.py::viterbi_assoc_batch":
+        "mesh-sharded re-jit of assoc decode (signature owned by "
+        "ops/assoc_viterbi.py; needs a Mesh, no stand-alone eval cases)",
+}
+
+# ---- device lanes / host-sync whitelist (DP rules) -------------------------
+# DEVICE_LANES are the prep/dispatch/drain thread entry points the
+# placement pass walks (the real submits go through the _lane_stage
+# indirection, so structural pool-root detection cannot find them).
+# SYNC_POINTS are the functions allowed to materialise device arrays on
+# the host (np.asarray/.item()/float()): traversal from a lane stops
+# there. Everything else reachable from a lane that synchronises is a
+# DP001 — the class of bug that silently serialises the pipeline.
+DEVICE_LANES: Dict[str, str] = {
+    "reporter_tpu/matcher/matcher.py::SegmentMatcher._dispatch_stage":
+        "dispatch lane: jit call + async d2h start",
+    "reporter_tpu/matcher/matcher.py::SegmentMatcher._drain_stage":
+        "drain lane: d2h wait + assembly",
+    "reporter_tpu/graph/route_device.py::DeviceRouteKernel.fill_prep":
+        "prep-thread route fill (native prepare_batch skip_routes path)",
+}
+
+SYNC_POINTS: Dict[str, str] = {
+    "reporter_tpu/matcher/matcher.py::SegmentMatcher._drain_stage":
+        "THE d2h gather: np.asarray(decoded) under matcher.decode_wait",
+    "reporter_tpu/matcher/batchpad.py::PaddedBatch.finalize_wire":
+        "deferred route resolve + wire-dtype decision at dispatch time",
+    "reporter_tpu/graph/route_device.py::DeferredRoutes.write_back":
+        "route-tensor d2h write into the prep dict (idempotent)",
+}
+
+# ---- fallback parity pairs (FB rules) --------------------------------------
+# Keyed by circuit-breaker domain: every dual path (a device/native fast
+# path with a byte-identical host fallback) declares its fault site, its
+# kill-switch knob, and the parity test that pins the two paths equal.
+# Two-sided with the code (fallback FB001/FB002): a CircuitBreaker
+# domain with no pair here is an undeclared dual path, and a pair whose
+# legs dangle (unknown site/knob, missing test) is a paper contract.
+FALLBACK_PAIRS: Dict[str, Dict[str, str]] = {
+    "matcher.circuit": {  # native prep <-> numpy prep
+        "fault_site": "native.prep",
+        "knob": "REPORTER_TPU_NATIVE",
+        "parity_test": "tests/test_report_writer.py::"
+                       "test_report_json_native_equals_fallback_bytes",
+    },
+    "matcher.circuit.decode": {  # device decode <-> numpy oracle
+        "fault_site": "decode.dispatch",
+        "knob": "REPORTER_TPU_DECODE",
+        "parity_test": "tests/test_faults.py::TestDecodeDomain",
+    },
+    "matcher.circuit.route": {  # device routes <-> host Dijkstra
+        "fault_site": "route.device",
+        "knob": "REPORTER_TPU_ROUTE_DEVICE",
+        "parity_test": "tests/test_route_device.py::"
+                       "test_reports_byte_identical",
+    },
+    "wire.circuit": {  # native wire writer <-> python columnar writer
+        "fault_site": "wire.native",
+        "knob": "REPORTER_TPU_WIRE_NATIVE",
+        "parity_test": "tests/test_report_writer.py::"
+                       "test_wire_cross_path_property",
+    },
+}
+
 __all__ = ["ENV_KNOBS", "METRICS", "FAULT_SITES", "DURABLE_MODULES",
-           "EPOCH_COMMIT_CONTRACTS"]
+           "EPOCH_COMMIT_CONTRACTS", "KERNEL_CONTRACTS", "DEVICE_LANES",
+           "SYNC_POINTS", "FALLBACK_PAIRS"]

@@ -285,9 +285,10 @@ class DeviceRouteKernel:
         if defer:
             metrics.count("route.device.deferred_chunks")
             return DeferredRoutes(route, dev_max, B, T)
-        out["route_m"][:B, :T - 1] = np.asarray(route)
-        out["max_finite"][0] = max(float(out["max_finite"][0]),
-                                   float(dev_max))
+        # synchronous path: materialise through the same declared sync
+        # point as the deferred one (registry.SYNC_POINTS write_back) —
+        # one d2h site, byte-identical either way
+        DeferredRoutes(route, dev_max, B, T).write_back(out)
         return None
 
     def _relax(self, srcs: np.ndarray, chunk_bound) -> tuple:

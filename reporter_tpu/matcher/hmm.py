@@ -82,7 +82,11 @@ def transition_scores(route_m: jnp.ndarray, gc_m: jnp.ndarray,
     gc_m = gc_m.astype(jnp.float32)
     dev = jnp.abs(route_m - gc_m[:, None, None])
     scores = jnp.where(route_m < UNREACHABLE_THRESHOLD, -dev / beta, NEG_INF)
-    identity = jnp.where(jnp.eye(K, dtype=bool), 0.0, NEG_INF)
+    # both branches must carry an explicit dtype: with two weak Python
+    # scalars no array operand pins the result, so under jax_enable_x64
+    # this would silently widen to f64 (lint TC003)
+    identity = jnp.where(jnp.eye(K, dtype=bool),
+                         jnp.float32(0.0), jnp.float32(NEG_INF))
     scores = jnp.where((case_to == SKIP)[:, None, None], identity[None], scores)
     return jnp.where((case_to == RESTART)[:, None, None], 0.0, scores)
 

@@ -61,6 +61,14 @@ def _circuit_knobs() -> tuple:
             _env_float("REPORTER_TPU_CIRCUIT_COOLDOWN_S", 30.0))
 
 
+def _native_disabled() -> bool:
+    """REPORTER_TPU_NATIVE=off|0|false|numpy is the matcher.circuit
+    kill switch: force the numpy prep fallback even when the C++ host
+    runtime is importable (incident lever; default auto-detect)."""
+    return os.environ.get("REPORTER_TPU_NATIVE", "").strip().lower() \
+        in ("0", "off", "false", "numpy")
+
+
 def _route_device_enabled() -> bool:
     """REPORTER_TPU_ROUTE_DEVICE opts the device route kernel in (off by
     default: the host path is the battle-tested oracle, and the kernel
@@ -412,8 +420,13 @@ class SegmentMatcher:
         self._route_cache: Optional[RouteCache] = None
         self._fallback_lock = _locks.new_lock("matcher.fallback")
         # C++ host runtime when available (and not explicitly disabled);
-        # numpy fallback otherwise — identical contract
+        # numpy fallback otherwise — identical contract. The
+        # REPORTER_TPU_NATIVE knob is the matcher.circuit kill switch:
+        # "off" forces the numpy prep leg without rebuilding the server
+        # (explicit use_native=True still wins — tests ask by hand).
         self.runtime = None
+        if use_native is None and _native_disabled():
+            use_native = False
         if use_native is not False:
             from .. import native
             if native.available():
@@ -442,7 +455,10 @@ class SegmentMatcher:
         self.circuit_decode = CircuitBreaker("matcher.circuit.decode",
                                              threshold=threshold,
                                              cooldown_s=cooldown)
-        self.circuit_assemble = CircuitBreaker("matcher.circuit.assemble",
+        # assemble's breaker guards quarantine/shedding of poisoned
+        # traces inside ONE implementation — there is no dual path to
+        # pair, so no FALLBACK_PAIRS entry
+        self.circuit_assemble = CircuitBreaker("matcher.circuit.assemble",  # lint: ignore[FB001]
                                                threshold=threshold,
                                                cooldown_s=cooldown)
         self.circuit_route = CircuitBreaker("matcher.circuit.route",

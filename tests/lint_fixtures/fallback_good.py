@@ -1,0 +1,5 @@
+"""Fallback fixture: every constructed breaker domain carries a full
+FALLBACK_PAIRS entry (fault site + kill switch + parity test)."""
+from reporter_tpu.utils.circuit import CircuitBreaker
+
+covered = CircuitBreaker("covered.circuit", threshold=3, cooldown_s=1.0)

@@ -1,0 +1,27 @@
+"""Placement fixture: undisciplined host materialisation on a device
+lane (against injected ``lanes=(...::Lane.stage,)``,
+``sync_points=(...::Lane.drain,)``, ``entry_names={"kernel_entry"}``)."""
+import numpy as np
+
+
+def kernel_entry(x):
+    return x
+
+
+class Lane:
+    def stage(self, batch):
+        out = kernel_entry(batch)
+        host = np.asarray(out)  # DP001: d2h materialisation outside SYNC_POINTS
+        for _ in range(3):
+            y = kernel_entry(batch)
+            val = float(y)  # DP002: host cast inside a dispatching loop
+        arr = np.zeros(4)
+        res = kernel_entry(arr)  # DP003: bare numpy array into a jit entry
+        return self.helper(), host, val, res
+
+    def helper(self):
+        d = kernel_entry(np.ones(2))
+        return d.item()  # DP001: reachable helper materialises its dispatch
+
+    def drain(self, out):
+        return np.asarray(out)  # the declared sync point: legal site

@@ -1,0 +1,22 @@
+"""Tensor-contract fixture: an uncontracted jit entry, a both-weak
+``jnp.where``, and array-valued statics (against injected
+``contracts={"reporter_tpu/ops/fixture_bad.py::contracted": ...}``,
+``full_scope=False``)."""
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1.0e30
+
+
+@jax.jit
+def uncontracted(x):  # TC002: jit entry with no KERNEL_CONTRACTS row
+    return x * 2.0
+
+
+@partial(jax.jit, static_argnames=("table", "missing"))
+def contracted(x, table):  # TC004: static 'missing' names no parameter
+    gap = jnp.where(x > 0, 0.0, NEG_INF)  # TC003: both branches weak
+    row = table[0]  # TC004: static 'table' subscripted like an array
+    return gap + row + x

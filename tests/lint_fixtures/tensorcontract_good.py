@@ -1,0 +1,17 @@
+"""Tensor-contract fixture: a contracted entry using only the sanctioned
+weak-scalar idioms (one weak branch against an array operand, explicit
+jnp dtypes) and shape-only statics."""
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1.0e30
+
+
+@partial(jax.jit, static_argnames=("n",))
+def contracted(x, n):
+    pad = jnp.zeros((n,), dtype=jnp.float32)
+    one_weak = jnp.where(x > 0, -0.5 * x, NEG_INF)
+    pinned = jnp.where(x > 0, jnp.float32(0.0), jnp.float32(NEG_INF))
+    return one_weak + pinned + pad

@@ -15,9 +15,10 @@ FIXTURES = os.path.join(REPO, "tests", "lint_fixtures")
 sys.path.insert(0, REPO)
 
 from reporter_tpu import analysis                      # noqa: E402
-from reporter_tpu.analysis import (abi, durability, fault_coverage,  # noqa: E402
-                                   hotpath, jit_hygiene, lockgraph, locks,
-                                   registry, registry_drift)
+from reporter_tpu.analysis import (abi, durability, fallback,  # noqa: E402
+                                   fault_coverage, hotpath, jit_hygiene,
+                                   lockgraph, locks, placement, registry,
+                                   registry_drift, tensorcontract)
 from reporter_tpu.analysis.core import SourceFile, parse_suppressions  # noqa: E402
 
 LIVE_CPP = os.path.join(REPO, abi.DEFAULT_CPP)
@@ -387,6 +388,228 @@ def test_faultcov_every_site_is_exercised():
         [f.render() for f in findings]
 
 
+# ---- tensor contracts ------------------------------------------------------
+
+_TC_FIXTURE_CONTRACTS = {
+    "reporter_tpu/ops/fixture_bad.py::contracted": "fixture",
+    "reporter_tpu/ops/fixture_good.py::contracted": "fixture"}
+
+
+def _run_tensor(name, relpath):
+    sf = _fixture(name, relpath)
+    findings = analysis.filter_suppressed(
+        tensorcontract.run([sf], REPO, contracts=_TC_FIXTURE_CONTRACTS,
+                           full_scope=False), [sf])
+    return sf, findings
+
+
+def test_tensorcontract_fires_on_bad_fixture():
+    sf, findings = _run_tensor("tensorcontract_bad.py",
+                               "reporter_tpu/ops/fixture_bad.py")
+    _assert_matches_annotations(sf, findings, ("TC002", "TC003", "TC004"))
+
+
+def test_tensorcontract_silent_on_good_fixture():
+    _, findings = _run_tensor("tensorcontract_good.py",
+                              "reporter_tpu/ops/fixture_good.py")
+    assert findings == []
+
+
+def test_tensorcontract_live_entries_are_all_contracted():
+    """TC002 forward on the live tree: every enumerated jit/pallas entry
+    has a KERNEL_CONTRACTS row (the acceptance gate's two-sided half
+    that needs no eval harness)."""
+    files = analysis.collect_py_files(REPO)
+    findings = tensorcontract.run(files, REPO, full_scope=False)
+    assert [f for f in findings if f.rule == "TC002"] == [], \
+        [f.render() for f in findings]
+
+
+def test_tensorcontract_signature_drift_detected():
+    """Live injection: mutate a fresh-signature copy's output dtype
+    (f32 -> f64 widening, the HBM-doubling class) — TC001 fires at the
+    kernel's def line with the drift spelled out."""
+    import copy
+    import json
+    with open(os.path.join(REPO, "tools", "kernel_contracts.json"),
+              encoding="utf-8") as f:
+        committed = json.load(f)
+    fresh = copy.deepcopy(committed)
+    key = "reporter_tpu/ops/route_relax.py::relax_csr"
+    fresh["entries"][key]["cases"][0]["outputs"][0][1] = "float64"
+    files = analysis.collect_py_files(REPO)
+    findings = tensorcontract.run(files, REPO, signatures=fresh)
+    assert any(f.rule == "TC001" and key in f.message
+               and "float64" in f.message
+               and f.path == "reporter_tpu/ops/route_relax.py"
+               for f in findings), [f.render() for f in findings]
+    # a dropped output is drift too, not silence
+    fresh = copy.deepcopy(committed)
+    fresh["entries"][key]["cases"][0]["outputs"].pop()
+    findings = tensorcontract.run(files, REPO, signatures=fresh)
+    assert any(f.rule == "TC001" and "output count" in f.message
+               for f in findings)
+
+
+def test_kernel_contracts_regen_containment():
+    """Seed-containment (the LEDGER.jsonl pattern): every committed
+    contract entry is contained in a fresh CPU-only regen, so hand
+    edits to tools/kernel_contracts.json cannot drift from the live
+    kernels — and the regen traces no entry the file lacks."""
+    import json
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    fresh = tensorcontract.compute_signatures(REPO)
+    with open(os.path.join(REPO, "tools", "kernel_contracts.json"),
+              encoding="utf-8") as f:
+        committed = json.load(f)
+    assert set(committed["entries"]) == set(fresh["entries"])
+    for key, entry in committed["entries"].items():
+        diff = tensorcontract._diff_entry(entry, fresh["entries"][key])
+        assert diff is None, f"{key}: {diff}"
+    assert tensorcontract.LAST_EVAL_SECONDS is not None
+
+
+# ---- placement -------------------------------------------------------------
+
+_DP_ENTRIES = {"kernel_entry"}
+
+
+def test_placement_fires_on_bad_fixture():
+    sf = _fixture("placement_bad.py",
+                  "reporter_tpu/matcher/fixture_bad.py")
+    findings = analysis.filter_suppressed(placement.run(
+        [sf], REPO,
+        lanes=("reporter_tpu/matcher/fixture_bad.py::Lane.stage",),
+        sync_points=("reporter_tpu/matcher/fixture_bad.py::Lane.drain",),
+        entry_names=_DP_ENTRIES, full_scope=False), [sf])
+    _assert_matches_annotations(sf, findings, ("DP001", "DP002", "DP003"))
+
+
+def test_placement_silent_on_good_fixture():
+    sf = _fixture("placement_good.py",
+                  "reporter_tpu/matcher/fixture_good.py")
+    findings = placement.run(
+        [sf], REPO,
+        lanes=("reporter_tpu/matcher/fixture_good.py::Lane.stage",),
+        sync_points=("reporter_tpu/matcher/fixture_good.py::Lane.drain",),
+        entry_names=_DP_ENTRIES, full_scope=False)
+    assert findings == []
+
+
+def test_placement_live_lanes_are_disciplined():
+    """The declared lanes materialise only through SYNC_POINTS on the
+    live tree — the PR 15 fill_prep tail now routes through
+    DeferredRoutes.write_back instead of an inline np.asarray."""
+    files = analysis.collect_py_files(REPO)
+    findings = analysis.filter_suppressed(
+        placement.run(files, REPO), files)
+    assert findings == [], [f.render() for f in findings]
+
+
+def test_placement_undeclared_sync_detected():
+    """Live injection (the durability-worker pattern): re-introduce the
+    inline materialisation this PR removed from fill_prep's synchronous
+    tail — DP001 fires at the real line on the route prep lane."""
+    import ast as _ast
+    live = _read(os.path.join(REPO, "reporter_tpu", "graph",
+                              "route_device.py"))
+    target = "DeferredRoutes(route, dev_max, B, T).write_back(out)"
+    assert target in live, "fill_prep tail drifted; update the injection"
+    mutated = live.replace(
+        target, 'out["route_m"][:B, :T - 1] = np.asarray(route)', 1)
+    bad = SourceFile(path="x",
+                     relpath="reporter_tpu/graph/route_device.py",
+                     text=mutated, tree=_ast.parse(mutated),
+                     suppressions={})
+    files = [bad if sf.relpath == bad.relpath else sf
+             for sf in analysis.collect_py_files(REPO)]
+    findings = placement.run(files, REPO)
+    assert any(f.rule == "DP001" and f.path == bad.relpath
+               and "'route'" in f.message for f in findings), \
+        [f.render() for f in findings]
+
+
+# ---- fallback parity -------------------------------------------------------
+
+_FB_FIXTURE_PAIRS = {"covered.circuit": {
+    "fault_site": "native.prep", "knob": "REPORTER_TPU_NATIVE",
+    "parity_test": "tests/test_faults.py::TestDecodeDomain"}}
+
+
+def test_fallback_fires_on_bad_fixture():
+    sf = _fixture("fallback_bad.py",
+                  "reporter_tpu/service/fixture_bad.py")
+    findings = analysis.filter_suppressed(
+        fallback.run([sf], REPO, pairs=_FB_FIXTURE_PAIRS,
+                     full_scope=False), [sf])
+    _assert_matches_annotations(sf, findings, ("FB001",))
+
+
+def test_fallback_silent_on_good_fixture():
+    sf = _fixture("fallback_good.py",
+                  "reporter_tpu/service/fixture_good.py")
+    findings = fallback.run([sf], REPO, pairs=_FB_FIXTURE_PAIRS,
+                            full_scope=False)
+    assert findings == []
+
+
+def test_fallback_live_pairs_are_fully_proven():
+    """All four dual paths carry full pairs, every parity test resolves,
+    and the one pairless breaker (matcher.circuit.assemble — quarantine,
+    not a dual path) is a documented suppression."""
+    files = analysis.collect_py_files(REPO)
+    raw = fallback.run(files, REPO)
+    assemble = [f for f in raw if f.rule == "FB001"
+                and "matcher.circuit.assemble" in f.message]
+    assert assemble, "the assemble suppression disappeared — update"
+    kept = analysis.filter_suppressed(raw, files)
+    assert kept == [], [f.render() for f in kept]
+
+
+def test_fallback_missing_leg_detected_at_registry_line():
+    """Live injection: drop the kill-switch leg from a FALLBACK_PAIRS
+    copy — FB002 fires at the domain's real registry.py line."""
+    import copy
+    pairs = copy.deepcopy(dict(registry.FALLBACK_PAIRS))
+    del pairs["matcher.circuit"]["knob"]
+    files = analysis.collect_py_files(REPO)
+    findings = fallback.run(files, REPO, pairs=pairs)
+    hits = [f for f in findings if f.rule == "FB002"
+            and "'knob'" in f.message]
+    assert hits, [f.render() for f in findings]
+    assert hits[0].path == "reporter_tpu/analysis/registry.py"
+    assert hits[0].line > 1  # anchored at the real entry, not a stub
+
+
+def test_fallback_dropped_pair_detected_at_breaker_site():
+    """Drop a whole pair: FB001 fires at the real CircuitBreaker
+    construction in matcher.py (the two-sided contract's code half)."""
+    pairs = dict(registry.FALLBACK_PAIRS)
+    del pairs["matcher.circuit.route"]
+    files = analysis.collect_py_files(REPO)
+    findings = analysis.filter_suppressed(
+        fallback.run(files, REPO, pairs=pairs), files)
+    assert any(f.rule == "FB001"
+               and f.path == "reporter_tpu/matcher/matcher.py"
+               and "matcher.circuit.route" in f.message
+               for f in findings), [f.render() for f in findings]
+
+
+def test_fallback_dangling_parity_test_detected():
+    import copy
+    pairs = copy.deepcopy(dict(registry.FALLBACK_PAIRS))
+    pairs["wire.circuit"]["parity_test"] = \
+        "tests/test_report_writer.py::test_gone_forever"
+    files = analysis.collect_py_files(REPO)
+    findings = fallback.run(files, REPO, pairs=pairs)
+    assert any(f.rule == "FB003" and "test_gone_forever" in f.message
+               for f in findings), [f.render() for f in findings]
+    pairs["wire.circuit"]["parity_test"] = "tests/test_nowhere.py::t"
+    findings = fallback.run(files, REPO, pairs=pairs)
+    assert any(f.rule == "FB003" and "does not exist" in f.message
+               for f in findings)
+
+
 # ---- ABI cross-check -------------------------------------------------------
 
 def _read(path):
@@ -546,7 +769,10 @@ def test_list_rules_covers_all_passes():
                  "ABI001", "ABI004", "LD001", "LD002", "LD003",
                  "DUR001", "DUR002", "DUR003", "DUR004",
                  "KN001", "KN002", "MT001", "MT002",
-                 "FP001", "FP002", "FP003"):
+                 "FP001", "FP002", "FP003",
+                 "TC001", "TC002", "TC003", "TC004",
+                 "DP001", "DP002", "DP003",
+                 "FB001", "FB002", "FB003"):
         assert rule in proc.stdout
 
 
@@ -570,6 +796,14 @@ def test_contracts_only_guard_is_clean_and_catches_drift(tmp_path):
     assert any(f.rule == "KN002"
                and "REPORTER_TPU_PROBE_TRIES" in f.message
                for f in findings)
+
+
+def test_tensors_only_guard_is_clean_and_reports_eval_time():
+    """--tensors-only exits 0 on the live tree and prints the eval_shape
+    harness wall time (the CI budget guard's visibility hook)."""
+    proc = _lint("--tensors-only")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "eval_shape harness" in proc.stdout
 
 
 def test_partial_run_skips_whole_package_contract_directions():
@@ -626,7 +860,8 @@ def test_every_rule_id_has_a_fixture_test():
                                     text))
     # whole-package reverse directions (dead entries, README drift,
     # coverage) are pinned by the live-tree tests above, not fixtures
-    full_scope_only = {"KN002", "MT002", "FP002", "FP003"}
+    full_scope_only = {"KN002", "MT002", "FP002", "FP003",
+                       "TC001", "FB002", "FB003"}
     # the RC rules are RUNTIME findings (the lock witness / guarded
     # audit, ISSUE 10): they pin through tests/test_racecheck.py
     # driving real threads, not through AST fixtures

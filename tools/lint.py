@@ -7,7 +7,13 @@ Usage:
   python tools/lint.py --abi-only      # just the ctypes<->C++ ABI guard
   python tools/lint.py --contracts-only  # just the cross-layer contract
                                        # passes (registry/durability/
-                                       # lock-graph/fault-coverage)
+                                       # lock-graph/fault-coverage/
+                                       # tensor-contract/placement/
+                                       # fallback)
+  python tools/lint.py --tensors-only  # just the device-contract passes
+                                       # (TC/DP/FB): eval_shape harness,
+                                       # transfer discipline, fallback
+                                       # parity
   python tools/lint.py --list-rules    # rule catalogue
   python tools/lint.py path.py ...     # restrict the code passes to paths
 
@@ -61,6 +67,12 @@ def main(argv=None) -> int:
                              "(registry drift, fault coverage, "
                              "durability, lock graph); ignores the "
                              "baseline — fast pre-commit guard")
+    parser.add_argument("--tensors-only", action="store_true",
+                        help="run only the device-contract passes "
+                             "(TC kernel signatures via eval_shape, DP "
+                             "transfer discipline, FB fallback parity); "
+                             "ignores the baseline — guard for kernel/"
+                             "placement changes, needs no device")
     parser.add_argument("--locks-only", action="store_true",
                         help="run only the static lock passes (LD001 "
                              "discipline + LD002/LD003 lock graph); "
@@ -121,6 +133,25 @@ def main(argv=None) -> int:
             return 1
         print(f"reporter-lint --locks-only: lock discipline holds "
               f"({len(files)} files)")
+        return 0
+
+    if args.tensors_only:
+        files = analysis.collect_py_files(REPO_ROOT, DEFAULT_ROOTS)
+        findings = sorted(analysis.filter_suppressed(
+            [*analysis.tensorcontract.run(files, REPO_ROOT),
+             *analysis.placement.run(files, REPO_ROOT),
+             *analysis.fallback.run(files, REPO_ROOT)], files))
+        for f in findings:
+            print(f.render())
+        eval_s = analysis.tensorcontract.LAST_EVAL_SECONDS
+        timing = "" if eval_s is None else \
+            f" (eval_shape harness: {eval_s:.1f}s)"
+        if findings:
+            print(f"reporter-lint --tensors-only: {len(findings)} device-"
+                  f"contract finding(s){timing}", file=sys.stderr)
+            return 1
+        print(f"reporter-lint --tensors-only: device contracts hold "
+              f"({len(files)} files){timing}")
         return 0
 
     if args.contracts_only:
@@ -189,8 +220,10 @@ def main(argv=None) -> int:
               f"baseline entr(y/ies)", file=sys.stderr)
         return 1
     n_base = f" ({len(baseline)} baselined)" if baseline else ""
+    eval_s = analysis.tensorcontract.LAST_EVAL_SECONDS
+    timing = "" if eval_s is None else f", eval_shape {eval_s:.1f}s"
     print(f"reporter-lint: clean — {len(files)} files, "
-          f"{len(analysis.ALL_RULES)} rules{n_base}")
+          f"{len(analysis.ALL_RULES)} rules{n_base}{timing}")
     return 0
 
 
