@@ -48,6 +48,11 @@ subprocess that proves the threaded device lanes on the accelerator
 before the artifact run trusts them; on failure the run serializes
 with REPORTER_TPU_PIPELINE=0 and records why in ``probe``),
 REPORTER_TPU_PROBE_TIMEOUT_S / _TRIES (probe patience).
+
+One argv escape hatch: ``python bench.py --feed-fanout N [...]`` runs
+the freshness tier's change-feed fan-out leg (tools/
+feed_fanout_bench.py — N concurrent /feed subscribers over a pre-fork
+fleet) instead of the matcher legs.
 """
 import json
 import os
@@ -776,4 +781,12 @@ def main():
 
 
 if __name__ == "__main__":
+    if "--feed-fanout" in sys.argv[1:]:
+        # the freshness tier's fan-out leg (ISSUE 18) lives in its own
+        # module — a serving bench like tools/prefork_bench.py, not a
+        # matcher throughput leg — but rides bench.py's front door so
+        # `python bench.py --feed-fanout 1000` is one command
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from tools import feed_fanout_bench
+        sys.exit(feed_fanout_bench.main(sys.argv[1:]))
     sys.exit(main())

@@ -89,6 +89,10 @@ ENV_KNOBS: Dict[str, str] = {
     "REPORTER_TPU_PRESSURE_HOLD_S": "degradation-ladder hysteresis dwell",
     "REPORTER_TPU_BACKPRESSURE": "streaming offer backpressure (0 = off)",
     "REPORTER_TPU_BACKPRESSURE_LATENCY_S": "submit-EWMA slow-down threshold",
+    "REPORTER_TPU_FRESHNESS": "freshness tier (overlay/feed/viewport) gate",
+    "REPORTER_TPU_FRESHNESS_MB": "recent-delta overlay byte budget (MB)",
+    "REPORTER_TPU_FRESHNESS_WAITERS": "/feed long-poll waiter cap (shed past)",
+    "REPORTER_TPU_FRESHNESS_POLL_S": "feed store-watch pace (cross-process)",
 }
 
 # ---- metric names ----------------------------------------------------------
@@ -186,6 +190,11 @@ METRICS: Dict[str, str] = {
     "datastore.city.*": "city-residency LRU loads/hits/evictions",
     "datastore.profile.exports": "route-memo profile artifacts written",
     "datastore.profile.warmed_pairs": "memo pairs pre-warmed at city load",
+    # freshness tier (ISSUE 18: datastore/freshness.py + feed.py)
+    "overlay.*": "recent-delta overlay: records/deduped/evicted/committed",
+    "feed.*": "change feed: events/polls/delivered/shed/timeouts/watch",
+    "viewport.*": "materialised viewport summaries: refreshes/queries",
+    "service.requests.feed": "/feed long-poll requests",
     # observability
     "flightrec.dumps": "flight-recorder postmortems written",
     # device-level profiler (obs/profiler.py)

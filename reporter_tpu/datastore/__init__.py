@@ -15,6 +15,10 @@ into per-segment speed histograms and answering queries:
 - :mod:`lease`      — the cross-process writer lease every mutating
   entry point holds
 - :mod:`compactor`  — background delta-pressure compaction (lease-owned)
+- :mod:`freshness`  — recent-delta overlay (``window=`` queries) +
+  materialised viewport summaries
+- :mod:`feed`       — bbox change feed: monotone cursor, long-poll,
+  bounded waiters
 - :mod:`profile`    — per-city route-memo pre-warm artifact
 
 :class:`LocalDatastore` is the one-stop facade the service's
@@ -27,6 +31,14 @@ from typing import Optional, Sequence
 
 from .aggregate import Delta, aggregate, merge_deltas
 from .compactor import BackgroundCompactor
+from .feed import ChangeFeed, FeedOverload
+from .freshness import (
+    FreshnessTier,
+    OverlayView,
+    RecentDeltaOverlay,
+    freshness_enabled,
+    parse_window,
+)
 from .ingest import ingest_dir, ingest_file, parse_tile_csv, scan_tiles
 from .lease import LeaseHeldElsewhere, StoreLease
 from .profile import export_profile, load_profile, profile_path, warm_matcher
@@ -68,41 +80,70 @@ class LocalDatastore(HistogramStore):
                    limit: Optional[int] = None) -> dict:
         return ingest_dir(self, root, delete=delete, limit=limit)
 
+    def enable_freshness(self, clock=None, budget_bytes=None):
+        """Attach the freshness tier (freshness.py) — the recent-delta
+        overlay, change feed and viewport summaries — honouring the
+        ``REPORTER_TPU_FRESHNESS`` gate. Idempotent; returns the tier
+        (or None when the gate disables it)."""
+        if self.freshness is None and freshness_enabled():
+            self.freshness = FreshnessTier(self, clock=clock,
+                                           budget_bytes=budget_bytes)
+        return self.freshness
+
+    def _query_store(self, window):
+        """The store the query layer should sweep for this request:
+        ``window=None`` is ALWAYS ``self`` (the pre-freshness path,
+        byte-identical by construction); a window resolves through the
+        overlay. A process without the tier serves ``inf`` as the
+        plain compacted store (the overlay would add nothing) and a
+        finite window as empty (it has witnessed no recent ingests —
+        windows need the tee co-located, see README)."""
+        if window is None:
+            return self
+        import math
+        w = parse_window(window)
+        if self.freshness is not None:
+            return self.freshness.query_view(w)
+        return self if math.isinf(w) else OverlayView({})
+
     def query(self, segment_id: int,
               hours: Optional[Sequence[int]] = None,
               percentiles: Sequence[float] = DEFAULT_PERCENTILES,
-              max_transitions: int = 32) -> dict:
-        return query_segment(self, segment_id, hours=hours,
-                             percentiles=percentiles,
+              max_transitions: int = 32, window=None) -> dict:
+        return query_segment(self._query_store(window), segment_id,
+                             hours=hours, percentiles=percentiles,
                              max_transitions=max_transitions)
 
     def query_many(self, segment_ids,
                    hours: Optional[Sequence[int]] = None,
                    percentiles: Sequence[float] = DEFAULT_PERCENTILES,
-                   max_transitions: int = 32) -> list:
+                   max_transitions: int = 32, window=None) -> list:
         """Batched spelling of :meth:`query`: one sweep per partition's
         live segment files serves the whole id list (datastore/query.py)
         — answer-identical to N single queries by construction."""
-        return query_many(self, segment_ids, hours=hours,
-                          percentiles=percentiles,
+        return query_many(self._query_store(window), segment_ids,
+                          hours=hours, percentiles=percentiles,
                           max_transitions=max_transitions)
 
     def query_bbox(self, bbox, level: int,
                    hours: Optional[Sequence[int]] = None,
                    percentiles: Sequence[float] = DEFAULT_PERCENTILES,
                    max_transitions: int = 32,
-                   max_segments: Optional[int] = None) -> dict:
+                   max_segments: Optional[int] = None,
+                   window=None) -> dict:
         kwargs = {}
         if max_segments is not None:
             kwargs["max_segments"] = max_segments
-        return query_bbox(self, bbox, level, hours=hours,
-                          percentiles=percentiles,
+        return query_bbox(self._query_store(window), bbox, level,
+                          hours=hours, percentiles=percentiles,
                           max_transitions=max_transitions, **kwargs)
 
 
 __all__ = [
-    "BackgroundCompactor", "Delta", "HistogramStore",
-    "LeaseHeldElsewhere", "LocalDatastore", "ObservationBatch",
+    "BackgroundCompactor", "ChangeFeed", "Delta", "FeedOverload",
+    "FreshnessTier", "HistogramStore", "LeaseHeldElsewhere",
+    "LocalDatastore", "ObservationBatch", "OverlayView",
+    "RecentDeltaOverlay", "freshness_enabled", "parse_window",
     "StoreLease", "aggregate", "merge_deltas", "parse_tile_csv",
     "scan_tiles", "ingest_file", "ingest_dir", "query_segment",
     "query_many", "query_bbox", "hours_for_range", "parse_hours_spec",

@@ -96,6 +96,17 @@ class BackgroundCompactor:
         another process compacted meanwhile is skipped, not
         re-merged."""
         metrics.count("datastore.compactor.passes")
+        # freshness maintenance rides the paced pass, BEFORE the
+        # lease-gated compaction (both are read-only — every process
+        # runs them, leased or not): viewport materialisations refresh
+        # off the hot path, and the feed's store watcher publishes
+        # tile events for commits other processes made
+        fresh = getattr(self.store, "freshness", None)
+        if fresh is not None:
+            try:
+                fresh.on_compactor_pass()
+            except Exception as e:
+                logger.error("freshness pass failed (will retry): %s", e)
         backlog = self.pending(refresh=True)
         if not backlog["partitions_over"]:
             return {"compacted": 0, "backlog": backlog}

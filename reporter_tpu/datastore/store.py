@@ -130,6 +130,11 @@ class HistogramStore:
         # worker tee can all point at this root at once, and the
         # in-process _lock above cannot see the other processes
         self.lease = StoreLease(root)
+        # optional freshness tier (freshness.py, attached via
+        # LocalDatastore.enable_freshness): ingest() records every
+        # per-partition delta here so window=/feed surfaces see a
+        # flush the instant it lands, whatever producer drove it
+        self.freshness = None
 
     # -- paths -------------------------------------------------------------
     def partition_dir(self, level: int, index: int) -> str:
@@ -360,9 +365,25 @@ class HistogramStore:
         append — O(touched partitions), not a store-wide sweep (the
         worker tee runs this on every flush)."""
         rows = 0
+        # the freshness hook records EVERY partition delta this batch
+        # carries — committed, deduped (the overlay dedupes on the same
+        # key, so it no-ops there too) or failed (in_store=False: the
+        # tile is being spooled, and window=∞ must serve those rows
+        # from the overlay until the dead-letter replay lands)
+        fresh = self.freshness
         for (level, index), delta in aggregate(obs).items():
-            if self.append(level, index, delta,
-                           ingest_key=ingest_key) is None:
+            try:
+                name = self.append(level, index, delta,
+                                   ingest_key=ingest_key)
+            except Exception:
+                if fresh is not None:
+                    fresh.record(level, index, delta, ingest_key,
+                                 in_store=False)
+                raise
+            if fresh is not None:
+                fresh.record(level, index, delta, ingest_key,
+                             in_store=True)
+            if name is None:
                 continue
             rows += delta.rows
             if max_deltas is not None or max_delta_bytes is not None:

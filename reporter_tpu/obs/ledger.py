@@ -211,6 +211,36 @@ def _bigreplay_entry(source: str, d: dict) -> dict:
                        f" writers={d.get('writers')}"}
 
 
+def _feed_entry(source: str, d: dict) -> dict:
+    """One ledger entry from a tools/feed_fanout_bench.py artifact
+    (the ISSUE 18 freshness-tier fan-out leg). ``vs_baseline`` holds
+    the fanout ratio — delivered subscribers over subscribers, 1.0
+    when every long-poll received the measured commit — and the
+    context carries delivery p99 and the shed/loss accounting. Kind
+    ``feed_fanout`` is excluded from the bench comparable pool
+    (tools/perf_gate.py ``comparable_pool``); gate with ``perf_gate
+    --feed`` instead. Scope follows subscriber count: the >= 1000
+    acceptance leg is ``full``, CI-scale runs are ``smoke``."""
+    subs = d.get("subscribers") or 0
+    return {"source": source,
+            "label": source.replace("BENCH_", "").replace(".json", ""),
+            "kind": "feed_fanout",
+            "scope": "full" if subs >= 1000 else "smoke",
+            "platform": "cpu", "decode": None, "pipelined": None,
+            "vs_baseline": d.get("fanout_ratio"),
+            "traces_per_sec": None,
+            "baseline_tps": None, "stage_shares": None,
+            "n_devices": None,
+            "ok": d.get("silent_lost") == 0 and not d.get("errors"),
+            "context": f"subscribers={subs} procs={d.get('procs')}"
+                       f" delivered={d.get('delivered')}"
+                       f" shed={d.get('shed')}"
+                       f" shed_events={d.get('shed_events')}"
+                       f" errors={d.get('errors')}"
+                       f" silent_lost={d.get('silent_lost')}"
+                       f" p99_ms={d.get('delivery_p99_ms')}"}
+
+
 def seed_entries(repo: str) -> List[dict]:
     """Normalise every checked-in perf artifact into ledger entries."""
     entries: List[dict] = []
@@ -324,6 +354,14 @@ def seed_entries(repo: str) -> List[dict]:
         with open(path, encoding="utf-8") as f:
             d = json.load(f)
         entries.append(_bigreplay_entry(os.path.basename(path), d))
+
+    # change-feed fan-out verdicts (ISSUE 18): subscriber delivery
+    # accounting + latency through the pre-fork fleet
+    for path in sorted(glob.glob(os.path.join(repo,
+                                              "BENCH_FEED_r*.json"))):
+        with open(path, encoding="utf-8") as f:
+            d = json.load(f)
+        entries.append(_feed_entry(os.path.basename(path), d))
     return entries
 
 

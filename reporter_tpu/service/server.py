@@ -48,9 +48,17 @@ from .report import report, report_wire
 # 200 or 503;
 # /profile is the device-level profiler (obs/profiler.py): per-shape
 # compile telemetry, per-chunk bucket-occupancy wide events, shadow-
-# accuracy verdicts
+# accuracy verdicts;
+# /feed is the change-feed long-poll (datastore/feed.py): bbox
+# subscribers block on a monotone cursor instead of polling /histogram
 ACTIONS = {"report", "stats", "metrics", "histogram", "health",
-           "profile"}
+           "profile", "feed"}
+
+#: pressure-ladder rung at which /feed sheds subscribers (429 +
+#: Retry-After): rung 2 (shed_trace) — one rung BEFORE the ladder
+#: starts degrading the match path itself (coarse_buckets), so feed
+#: fan-out is always the first load dropped
+FEED_SHED_LEVEL = 2
 
 
 class ReporterService:
@@ -205,7 +213,10 @@ class ReporterService:
         (every resident segment of that level inside the lon/lat box),
         plus optional ``hours`` (list of hour-of-week ints),
         ``time_range`` ([t0, t1) epoch seconds, converted to the hour
-        set it covers), ``percentiles``, and ``city`` (multi-tenant
+        set it covers), ``percentiles``, ``window`` (freshness tier:
+        ``5m``/``300s``/``inf`` — see datastore/freshness.py),
+        ``viewport`` (with bbox+level: the materialised tile summaries,
+        one read per covered tile), and ``city`` (multi-tenant
         routing)."""
         routed = self._route(params, "histogram")
         if routed is not None:
@@ -214,6 +225,20 @@ class ReporterService:
             return 503, ('{"error":"no datastore attached; serve with a '
                          '--datastore directory"}')
         from ..datastore import DEFAULT_PERCENTILES, hours_for_range
+        if params.get("viewport"):
+            if params.get("bbox") is None or params.get("level") is None:
+                return 400, ('{"error":"viewport queries need bbox '
+                             'and level"}')
+            tier = self.datastore.enable_freshness()
+            if tier is None:
+                return 503, ('{"error":"freshness tier disabled '
+                             '(REPORTER_TPU_FRESHNESS=0)"}')
+            try:
+                result = tier.viewports.summarise(
+                    params["bbox"], int(params["level"]))
+            except (TypeError, ValueError) as e:
+                return 400, json.dumps({"error": str(e)})
+            return 200, json.dumps(result, separators=(",", ":"))
         seg = params.get("segment_id")
         segs = params.get("segments")
         bbox = params.get("bbox")
@@ -229,6 +254,13 @@ class ReporterService:
                              'epoch-seconds pair"}')
             hours = hours_for_range(int(t0), int(t1)).tolist()
         pcts = tuple(params.get("percentiles") or DEFAULT_PERCENTILES)
+        # window=: served through the freshness overlay's store view
+        # (enable the tier on demand so window=inf works in a serving
+        # process that never ingests); window-less requests take the
+        # exact pre-freshness path — byte-identical answers
+        window = params.get("window")
+        if window is not None:
+            self.datastore.enable_freshness()
         try:
             if bbox is not None:
                 if params.get("level") is None:
@@ -237,17 +269,59 @@ class ReporterService:
                 result = self.datastore.query_bbox(
                     bbox, int(params["level"]), hours=hours,
                     percentiles=pcts,
-                    max_segments=params.get("max_segments"))
+                    max_segments=params.get("max_segments"),
+                    window=window)
             elif segs is not None:
                 result = {"results": self.datastore.query_many(
                     [int(s) for s in segs], hours=hours,
-                    percentiles=pcts)}
+                    percentiles=pcts, window=window)}
             else:
                 result = self.datastore.query(int(seg), hours=hours,
-                                              percentiles=pcts)
+                                              percentiles=pcts,
+                                              window=window)
         except (TypeError, ValueError) as e:
             return 400, json.dumps({"error": str(e)})
         return 200, json.dumps(result, separators=(",", ":"))
+
+    def feed(self, params: dict) -> tuple[int, str]:
+        """Answer one /feed long-poll; (status, body). Sheds BEFORE
+        registering a waiter — on the pressure ladder (rung >=
+        ``FEED_SHED_LEVEL``: subscriber fan-out is dropped one rung
+        before the match path degrades) and on the feed's own bounded
+        waiter table — with 429 bodies carrying ``retry_after_s`` (the
+        handler lifts it into Retry-After: PR 14's explicit-retry
+        contract; a subscriber is never silently dropped)."""
+        routed = self._route(params, "feed")
+        if routed is not None:
+            return routed
+        if self.datastore is None:
+            return 503, ('{"error":"no datastore attached; serve with a '
+                         '--datastore directory"}')
+        tier = self.datastore.enable_freshness()
+        if tier is None:
+            return 503, ('{"error":"freshness tier disabled '
+                         '(REPORTER_TPU_FRESHNESS=0)"}')
+        from ..datastore.feed import FEED_RETRY_AFTER_S, FeedOverload
+        if admission.current_level() >= FEED_SHED_LEVEL:
+            metrics.count("feed.shed.pressure")
+            return 429, json.dumps(
+                {"error": "overloaded", "reason": "pressure",
+                 "retry_after_s": FEED_RETRY_AFTER_S})
+        try:
+            out = tier.feed.poll(
+                bbox=params.get("bbox"),
+                level=int(params["level"])
+                if params.get("level") is not None else None,
+                cursor=int(params.get("cursor", -1)),
+                timeout_s=min(float(params.get("timeout", 25.0)), 60.0),
+                max_events=int(params.get("max_events", 256)))
+        except FeedOverload as e:
+            return 429, json.dumps(
+                {"error": "overloaded", "reason": e.reason,
+                 "retry_after_s": e.retry_after_s})
+        except (TypeError, ValueError) as e:
+            return 400, json.dumps({"error": str(e)})
+        return 200, json.dumps(out, separators=(",", ":"))
 
     def health(self) -> tuple[int, str]:
         """Liveness + degradation probe; (status, JSON body).
@@ -331,6 +405,13 @@ class ReporterService:
             # growing backlog means compaction is falling behind the
             # tee — visible here long before queries slow down
             body["compaction"] = self.compactor.pending()
+        if self.datastore is not None \
+                and getattr(self.datastore, "freshness", None) is not None:
+            # freshness-tier gauges: overlay occupancy vs its byte
+            # budget (evictions here mean the window is effectively
+            # shorter than configured), feed waiters/sheds, viewport
+            # materialisation counts
+            body["freshness"] = self.datastore.freshness.snapshot()
         if self.cities is not None:
             body["cities"] = self.cities.snapshot()
         body["status"] = "ok" if healthy else "degraded"
@@ -455,6 +536,33 @@ def make_handler(service: ReporterService):
             if "percentiles" in params:
                 out["percentiles"] = [
                     float(p) for p in params["percentiles"][0].split(",") if p]
+            # ?window=5m|300s|inf — freshness-tier staleness bound
+            if "window" in params:
+                out["window"] = params["window"][0]
+            # ?viewport=1 — materialised tile summaries for bbox+level
+            if "viewport" in params:
+                out["viewport"] = params["viewport"][0].lower() \
+                    not in ("", "0", "off", "false")
+            return out
+
+        def _parse_feed(self, post: bool) -> dict:
+            """Feed params: JSON body / ``json=`` like /report, or bare
+            GET query params (``bbox=…&level=L&cursor=N&timeout=S``)."""
+            params = urllib.parse.parse_qs(
+                urllib.parse.urlsplit(self.path).query)
+            if post or "json" in params:
+                return self._parse(post)
+            out: dict = {}
+            if "bbox" in params:
+                out["bbox"] = [float(v) for v
+                               in params["bbox"][0].split(",")]
+            for key in ("level", "cursor", "max_events"):
+                if key in params:
+                    out[key] = int(params[key][0])
+            if "timeout" in params:
+                out["timeout"] = float(params["timeout"][0])
+            if "city" in params:
+                out["city"] = params["city"][0]
             return out
 
         def _do(self, post: bool):
@@ -505,6 +613,24 @@ def make_handler(service: ReporterService):
                 if code != 200:
                     metrics.count(f"service.errors.{code}")
                 self._respond(code, body)
+                return
+            if action == "feed":
+                try:
+                    params = self._parse_feed(post)
+                except Exception as e:
+                    self._respond(400, json.dumps({"error": str(e)}))
+                    return
+                metrics.count("service.requests.feed")
+                code, body = service.feed(params)
+                if code != 200:
+                    metrics.count(f"service.errors.{code}")
+                if code == 429:
+                    # _respond_shed lifts retry_after_s from the body
+                    # into Retry-After: every shed subscriber gets the
+                    # explicit retry signal (PR 14 contract)
+                    self._respond_shed(code, body)
+                else:
+                    self._respond(code, body)
                 return
             # the admission gate (ISSUE 15): shed BEFORE the body is
             # even parsed — a 429 must cost headers, not work. The

@@ -551,6 +551,12 @@ def main(argv=None):
     if args.datastore:
         from ..datastore import BackgroundCompactor, LocalDatastore
         datastore = LocalDatastore(args.datastore)
+        # freshness tier (datastore/freshness.py): the tee's ingest
+        # records every flushed delta into the recent-delta overlay +
+        # change feed, so /histogram?window= and /feed subscribers see
+        # a probe within one tee cycle (REPORTER_TPU_FRESHNESS=0 opts
+        # out and this is a no-op)
+        datastore.enable_freshness()
         max_deltas = args.datastore_max_deltas
         max_bytes = args.datastore_max_delta_bytes
         inline_deltas = inline_bytes = None

@@ -9,6 +9,10 @@
                                            [--hours 7-9|7,8,9]
                                            [--t0 EPOCH --t1 EPOCH]
                                            [--percentiles 25,50,75,95]
+                                           [--window 5m|90s|inf]
+  python -m reporter_tpu datastore feed    <store> [--bbox ... --level L]
+                                           [--cursor N] [--timeout S]
+                                           [--max-polls N]
   python -m reporter_tpu datastore profile <store> [--graph city.npz
                                            --replay traces.jsonl]
                                            [--cap N] [--city NAME]
@@ -18,7 +22,14 @@
 results dir OR its ``.deadletter`` spool; ``--delete`` removes each tile
 file after a successful append (the dead-letter replay contract).
 ``--segments`` / ``--bbox`` serve many segments through ONE
-``query_many`` sweep per partition (datastore/query.py). ``profile``
+``query_many`` sweep per partition (datastore/query.py); ``--window``
+answers from the freshness tier's recent-delta overlay (``5m``-style
+specs; ``inf`` merges overlay + compacted — see "Freshness tier" in
+the README). ``feed`` tails a change-feed cursor over the store: each
+long-poll prints one JSON line (events + next cursor) and the next
+poll resumes from it, so ``--max-polls N`` makes it scriptable the way
+the query commands are; cross-process commits surface via the store
+watcher, which the command forces once per poll. ``profile``
 with ``--replay`` runs the request JSONs (one per line) through a
 matcher on ``--graph`` and commits the native route memo's resident
 pairs as the store's ``.profile`` pre-warm artifact; without
@@ -115,6 +126,25 @@ def main(argv=None):
     p_qry.add_argument("--t1", type=int, default=None)
     p_qry.add_argument("--percentiles", default=None,
                        help="comma-separated, e.g. 25,50,75,95")
+    p_qry.add_argument("--window", default=None,
+                       help="freshness window: '5m'/'90s'/seconds for "
+                            "recent-overlay-only answers, 'inf' for "
+                            "overlay+compacted merge; omit for the "
+                            "compacted store only")
+
+    p_fed = sub.add_parser("feed", help="tail a change-feed cursor "
+                           "(one JSON line per long-poll)")
+    p_fed.add_argument("store")
+    p_fed.add_argument("--bbox", default=None,
+                       help="min_lon,min_lat,max_lon,max_lat viewport "
+                            "filter (needs --level)")
+    p_fed.add_argument("--level", type=int, default=None)
+    p_fed.add_argument("--cursor", type=int, default=-1,
+                       help="resume cursor; -1 = from now")
+    p_fed.add_argument("--timeout", type=float, default=25.0,
+                       help="seconds each long-poll blocks")
+    p_fed.add_argument("--max-polls", type=int, default=0,
+                       help="stop after N polls (0 = forever)")
 
     p_prf = sub.add_parser("profile", help="route-memo pre-warm "
                            "artifact: export from a replay, or show")
@@ -159,6 +189,13 @@ def main(argv=None):
         if args.percentiles:
             kwargs["percentiles"] = [
                 float(p) for p in args.percentiles.split(",") if p]
+        if args.window is not None:
+            from ..datastore.freshness import parse_window
+            try:
+                parse_window(args.window)
+            except ValueError as e:
+                parser.error(str(e))
+            kwargs["window"] = args.window
         if args.bbox is not None:
             bbox = [float(v) for v in args.bbox.split(",")]
             if args.max_segments is not None:
@@ -172,6 +209,26 @@ def main(argv=None):
             out = ds.query(args.segment, hours=hours, **kwargs)
         else:
             parser.error("query needs --segment, --segments or --bbox")
+    elif args.cmd == "feed":
+        tier = ds.enable_freshness()
+        if tier is None:
+            raise SystemExit("freshness tier disabled "
+                             "(REPORTER_TPU_FRESHNESS=0)")
+        bbox = None
+        if args.bbox is not None:
+            bbox = [float(v) for v in args.bbox.split(",")]
+        cursor, polls = args.cursor, 0
+        while args.max_polls <= 0 or polls < args.max_polls:
+            # surface commits other processes made since the last poll
+            # (the in-poll watcher is paced; a CLI tail wants each poll
+            # to see the store's latest state)
+            tier.feed.watch_store(force=True)
+            out = tier.feed.poll(bbox=bbox, level=args.level,
+                                 cursor=cursor, timeout_s=args.timeout)
+            cursor = out["cursor"]
+            polls += 1
+            print(json.dumps(out, separators=(",", ":")), flush=True)
+        return 0
     elif args.cmd == "profile":
         out = _profile(ds, args)
     else:

@@ -1,0 +1,771 @@
+"""Freshness-tier tests (ISSUE 18): the recent-delta overlay (window=
+queries, byte-identity pins, eviction bound, crash-replay dedupe), the
+bbox change feed (cursor semantics, resync, condition-notified delivery,
+waiter/pressure shedding over HTTP), materialised viewport summaries,
+the datastore CLI's --window / feed surfaces, and the end-to-end proof:
+a probe the worker tee flushed is visible via ``window=5m`` and
+delivered on an open ``/feed`` cursor within one tee cycle."""
+import json
+import math
+import os
+import socket
+import sys
+import threading
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+
+import numpy as np
+import pytest
+
+from reporter_tpu.core.osmlr import make_segment_id
+from reporter_tpu.core.types import Segment
+from reporter_tpu.datastore import (
+    BackgroundCompactor,
+    LocalDatastore,
+    ObservationBatch,
+    OverlayView,
+    aggregate,
+    parse_window,
+)
+from reporter_tpu.datastore.feed import ChangeFeed, FeedOverload
+from reporter_tpu.datastore.freshness import RecentDeltaOverlay
+from reporter_tpu.utils import metrics
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Monday 2017-01-02 08:00:00 UTC -> hour-of-week 8
+MON_8AM = 1483344000
+
+SID = make_segment_id(2, 756425, 10)
+NID = make_segment_id(2, 756425, 11)
+WORLD = [-180.0, -90.0, 180.0, 90.0]
+
+
+def _segs(n, t0=MON_8AM, duration=10.0, length=100, sid=SID, nid=NID,
+          spacing=30):
+    """n observations of `length` m in `duration` s (36 kph at defaults)."""
+    return [Segment(sid, nid, t0 + i * spacing, t0 + i * spacing + duration,
+                    length, 0) for i in range(n)]
+
+
+def _delta(n=3, **kw):
+    """One aggregated partition delta for overlay/feed unit tests."""
+    return aggregate(ObservationBatch.from_segments(_segs(n, **kw)))[
+        (2, 756425)]
+
+
+class _Clock:
+    """Injectable arrival clock (the feed/poll timeouts deliberately
+    ignore it — they are wall-clock, so freezing this cannot hang)."""
+
+    def __init__(self, t=float(MON_8AM)):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+
+class TestParseWindow:
+    def test_spellings(self):
+        assert parse_window(300) == 300.0
+        assert parse_window("300") == 300.0
+        assert parse_window("90s") == 90.0
+        assert parse_window("5m") == 300.0
+        assert parse_window("2h") == 7200.0
+        assert parse_window("1d") == 86400.0
+        for inf in ("inf", "INF", "infinity", "∞"):
+            assert math.isinf(parse_window(inf))
+
+    def test_rejects_junk(self):
+        for bad in ("bogus", "", "nan", "-5", 0, -1, "5x"):
+            with pytest.raises(ValueError):
+                parse_window(bad)
+
+
+class TestOverlay:
+    def test_dedupe_by_ingest_key(self):
+        ov = RecentDeltaOverlay(budget_bytes=1 << 20, clock=_Clock())
+        assert ov.record(2, 756425, _delta(), "flush-1") is not None
+        assert ov.record(2, 756425, _delta(), "flush-1") is None
+        assert ov.snapshot()["entries"] == 1
+        # keyless (ad-hoc CSV) ingests have no cross-restart identity:
+        # each records
+        assert ov.record(2, 756425, _delta(), None) is not None
+        assert ov.record(2, 756425, _delta(), None) is not None
+        assert ov.snapshot()["entries"] == 3
+
+    def test_in_store_upgrade_on_dedupe(self):
+        ov = RecentDeltaOverlay(budget_bytes=1 << 20, clock=_Clock())
+        e = ov.record(2, 756425, _delta(), "spooled", in_store=False)
+        assert e.in_store is False
+        # the dead-letter replay re-offers the same key after its
+        # append commits: the no-op still flips the entry to committed
+        assert ov.record(2, 756425, _delta(), "spooled",
+                         in_store=True) is None
+        assert e.in_store is True
+
+    def test_window_deltas_age_out(self):
+        clk = _Clock()
+        ov = RecentDeltaOverlay(budget_bytes=1 << 20, clock=clk)
+        ov.record(2, 756425, _delta(), "f1")
+        assert list(ov.window_deltas(300.0)) == [(2, 756425)]
+        clk.t += 301.0
+        assert ov.window_deltas(300.0) == {}
+        assert math.isinf(parse_window("inf"))  # inf never ages out
+
+    def test_eviction_bounds_bytes(self):
+        ov = RecentDeltaOverlay(budget_bytes=2000, clock=_Clock())
+        before = metrics.default.counter("overlay.evicted")
+        for i in range(16):
+            ov.record(2, 756425, _delta(), f"flush-{i}")
+        snap = ov.snapshot()
+        assert snap["evicted"] > 0
+        assert snap["bytes"] <= 2000
+        assert snap["entries"] >= 1  # never evicts to empty
+        assert metrics.default.counter("overlay.evicted") \
+            == before + snap["evicted"]
+
+
+class TestWindowQueries:
+    def test_windowless_byte_identity(self, tmp_path, monkeypatch):
+        """window=None never touches the tier: answers are
+        byte-identical to a store where the tier is gate-disabled."""
+        ds_on = LocalDatastore(str(tmp_path / "on"))
+        assert ds_on.enable_freshness() is not None
+        monkeypatch.setenv("REPORTER_TPU_FRESHNESS", "0")
+        ds_off = LocalDatastore(str(tmp_path / "off"))
+        assert ds_off.enable_freshness() is None
+        for ds in (ds_on, ds_off):
+            ds.ingest_segments(_segs(20), ingest_key="seed")
+        a = json.dumps(ds_on.query(SID), sort_keys=True)
+        b = json.dumps(ds_off.query(SID), sort_keys=True)
+        assert a == b
+        a = json.dumps(ds_on.query_bbox(WORLD, 2), sort_keys=True)
+        b = json.dumps(ds_off.query_bbox(WORLD, 2), sort_keys=True)
+        assert a == b
+
+    def test_finite_window_sees_recent_only(self, tmp_path):
+        clk = _Clock()
+        ds = LocalDatastore(str(tmp_path))
+        ds.enable_freshness(clock=clk)
+        ds.ingest_segments(_segs(5), ingest_key="f1")
+        assert ds.query(SID, window="5m")["count"] == 5
+        assert ds.query(SID, window=60)["count"] == 5
+        clk.t += 600.0
+        assert ds.query(SID, window="5m")["count"] == 0
+        # the durable store is unaffected by overlay aging
+        assert ds.query(SID)["count"] == 5
+
+    def test_inf_parity_after_flush_and_compact(self, tmp_path):
+        """The acceptance pin: once every append committed and a
+        compaction ran, window=∞ is byte-identical to the plain
+        query."""
+        ds = LocalDatastore(str(tmp_path))
+        ds.enable_freshness()
+        ds.ingest_segments(_segs(7), ingest_key="a")
+        ds.ingest_segments(_segs(4, t0=MON_8AM + 3600), ingest_key="b")
+        ds.compact()
+        plain = json.dumps(ds.query(SID), sort_keys=True)
+        merged = json.dumps(ds.query(SID, window="inf"), sort_keys=True)
+        assert merged == plain
+        plain = json.dumps(ds.query_bbox(WORLD, 2), sort_keys=True)
+        merged = json.dumps(ds.query_bbox(WORLD, 2, window="inf"),
+                            sort_keys=True)
+        assert merged == plain
+
+    def test_inf_serves_uncommitted_until_replay_lands(self, tmp_path):
+        """A spooled flush (append failed -> in_store=False) exists only
+        in the overlay: window=∞ must serve it on top of the compacted
+        store, and stop the moment the dead-letter replay commits."""
+        ds = LocalDatastore(str(tmp_path))
+        tier = ds.enable_freshness()
+        ds.ingest_segments(_segs(5), ingest_key="committed")
+        tier.overlay.record(2, 756425, _delta(3, t0=MON_8AM + 3600),
+                            "spooled-flush", in_store=False)
+        assert ds.query(SID)["count"] == 5
+        assert ds.query(SID, window="inf")["count"] == 8
+        # the replay lands (same ledger key): ∞ converges back
+        ds.ingest_segments(_segs(3, t0=MON_8AM + 3600),
+                           ingest_key="spooled-flush")
+        assert ds.query(SID)["count"] == 8
+        assert json.dumps(ds.query(SID, window="inf"), sort_keys=True) \
+            == json.dumps(ds.query(SID), sort_keys=True)
+
+    def test_crash_restart_replay_never_double_counts(self, tmp_path):
+        """A restarted tee replays its flushes with the same ingest
+        keys: the store ledger dedupes on disk, the fresh overlay
+        records each replayed delta as already-committed — so merged
+        ∞ reads stay byte-identical to compacted-only."""
+        ds = LocalDatastore(str(tmp_path))
+        ds.enable_freshness()
+        for i in range(3):
+            ds.ingest_segments(_segs(4, t0=MON_8AM + i * 60),
+                               ingest_key=f"flush-{i}")
+        rows_before = ds.stats()["rows"]
+        # "crash": a new process = new store handle + empty overlay
+        ds2 = LocalDatastore(str(tmp_path))
+        tier2 = ds2.enable_freshness()
+        for i in range(3):  # the replay
+            ds2.ingest_segments(_segs(4, t0=MON_8AM + i * 60),
+                                ingest_key=f"flush-{i}")
+        assert ds2.stats()["rows"] == rows_before
+        assert json.dumps(ds2.query(SID, window="inf"), sort_keys=True) \
+            == json.dumps(ds2.query(SID), sort_keys=True)
+        # a second replay of the same keys no-ops in the overlay too
+        n = tier2.overlay.snapshot()["entries"]
+        ds2.ingest_segments(_segs(4), ingest_key="flush-0")
+        assert tier2.overlay.snapshot()["entries"] == n
+
+    def test_window_without_tier(self, tmp_path, monkeypatch):
+        """Gate-disabled: ∞ degrades to the plain store (the overlay
+        would add nothing), finite windows are empty (this process has
+        witnessed no recent ingests), windowless untouched."""
+        monkeypatch.setenv("REPORTER_TPU_FRESHNESS", "off")
+        ds = LocalDatastore(str(tmp_path))
+        ds.ingest_segments(_segs(5))
+        assert ds.query(SID, window="inf")["count"] == 5
+        assert ds.query(SID, window="5m")["count"] == 0
+        assert ds.query(SID)["count"] == 5
+
+
+class TestChangeFeed:
+    def _feed(self, **kw):
+        return ChangeFeed(store=None, clock=_Clock(), **kw)
+
+    def test_cursor_monotone_and_from_now(self):
+        feed = self._feed()
+        for i in range(3):
+            feed._publish("delta", 2, 756425, [SID], False, 1)
+        out = feed.poll(cursor=0, timeout_s=0)
+        assert [e["seq"] for e in out["events"]] == [1, 2, 3]
+        assert out["cursor"] == 3 and not out["resync"]
+        # nothing past the returned cursor
+        again = feed.poll(cursor=out["cursor"], timeout_s=0)
+        assert again["events"] == [] and again["timeout"]
+        # cursor=-1 means "from now": the 3 old events are skipped
+        assert feed.poll(cursor=-1, timeout_s=0)["events"] == []
+
+    def test_ring_overflow_is_explicit_resync(self):
+        feed = self._feed(ring_events=2)
+        for _ in range(5):
+            feed._publish("delta", 2, 756425, [SID], False, 1)
+        out = feed.poll(cursor=0, timeout_s=0)
+        assert out["resync"] is True  # loss is never silent
+        assert [e["seq"] for e in out["events"]] == [4, 5]
+        # a cursor inside the ring does not resync
+        assert feed.poll(cursor=4, timeout_s=0)["resync"] is False
+
+    def test_bbox_filter(self):
+        feed = self._feed()
+        feed._publish("delta", 2, 756425, [SID], False, 1)
+        hit = feed.poll(bbox=WORLD, level=2, cursor=0, timeout_s=0)
+        assert len(hit["events"]) == 1
+        # a far-away viewport sees nothing (but the cursor advances
+        # with the ring so the subscriber never replays the miss)
+        miss = feed.poll(bbox=[0.0, 0.0, 0.1, 0.1], level=2, cursor=0,
+                         timeout_s=0)
+        assert miss["events"] == []
+        with pytest.raises(ValueError):
+            feed.poll(bbox=WORLD, cursor=0, timeout_s=0)  # needs level
+
+    def test_waiter_cap_sheds_explicitly(self):
+        feed = self._feed(max_waiters_n=0)
+        with pytest.raises(FeedOverload) as exc:
+            feed.poll(cursor=0, timeout_s=0)
+        assert exc.value.reason == "feed_waiters"
+        assert exc.value.retry_after_s >= 1
+        assert feed.snapshot()["shed"] == 1
+
+    def test_condition_notified_delivery(self, tmp_path):
+        """The no-sleep-polling pin: a blocked poll is woken by the
+        ingest hook's condition notify, not by a timer — delivery
+        latency is a small fraction of the poll timeout."""
+        ds = LocalDatastore(str(tmp_path))
+        tier = ds.enable_freshness()
+        got = {}
+
+        def subscribe():
+            t0 = time.monotonic()
+            got["out"] = tier.feed.poll(bbox=WORLD, level=2, cursor=0,
+                                        timeout_s=30)
+            got["elapsed"] = time.monotonic() - t0
+
+        th = threading.Thread(target=subscribe)
+        th.start()
+        deadline = time.monotonic() + 10
+        while tier.feed.snapshot()["waiters"] == 0:
+            assert time.monotonic() < deadline, "subscriber never waited"
+            time.sleep(0.005)
+        ds.ingest_segments(_segs(3), ingest_key="live")
+        th.join(timeout=10)
+        assert not th.is_alive()
+        assert got["elapsed"] < 5.0
+        (ev,) = got["out"]["events"]
+        assert ev["kind"] == "delta" and ev["tile_index"] == 756425
+        assert SID in ev["segments"] and ev["rows"] == 3
+
+    def test_store_watcher_cross_process(self, tmp_path):
+        """A second store handle on the same root (the pre-fork fleet
+        shape): its feed surfaces the writer's commits as tile events
+        via the manifest-seq diff — after a silent baseline scan."""
+        writer = LocalDatastore(str(tmp_path))
+        writer.ingest_segments(_segs(2), ingest_key="old")
+        reader = LocalDatastore(str(tmp_path))
+        tier = reader.enable_freshness()
+        # first scan baselines: history is not replayed
+        assert tier.feed.watch_store(force=True) == 0
+        writer.ingest_segments(_segs(3, t0=MON_8AM + 3600),
+                               ingest_key="new")
+        assert tier.feed.watch_store(force=True) == 1
+        (ev,) = tier.feed.poll(cursor=0, timeout_s=0)["events"]
+        assert ev["kind"] == "tile" and ev["tile_index"] == 756425
+        assert ev["segments"] == []  # sweep the tile, ids unknown here
+
+
+class TestViewportSummaries:
+    def test_compactor_pass_materialises(self, tmp_path):
+        ds = LocalDatastore(str(tmp_path))
+        tier = ds.enable_freshness()
+        ds.ingest_segments(_segs(20), ingest_key="seed")
+        BackgroundCompactor(ds).run_once()
+        assert tier.viewports.snapshot() == {"tiles": 1, "refreshes": 1}
+        out = tier.viewports.summarise(WORLD, 2)
+        assert out["n_tiles"] == 1 and out["count"] == 20
+        (tile,) = out["tiles"]
+        assert tile["tile_index"] == 756425 and tile["n_segments"] == 1
+        assert tile["mean_kph"] == pytest.approx(36.0)
+        assert sum(tile["histogram"]["counts"]) == 20
+
+    def test_refresh_memoised_by_manifest_seq(self, tmp_path):
+        ds = LocalDatastore(str(tmp_path))
+        tier = ds.enable_freshness()
+        ds.ingest_segments(_segs(5), ingest_key="a")
+        assert tier.viewports.refresh()["refreshed"] == 1
+        assert tier.viewports.refresh()["refreshed"] == 0  # unchanged
+        ds.ingest_segments(_segs(5, t0=MON_8AM + 60), ingest_key="b")
+        assert tier.viewports.refresh()["refreshed"] == 1
+        assert tier.viewports.summarise(WORLD, 2)["count"] == 10
+
+    def test_empty_viewport(self, tmp_path):
+        ds = LocalDatastore(str(tmp_path))
+        tier = ds.enable_freshness()
+        out = tier.viewports.summarise(WORLD, 2)
+        assert out == {"bbox": WORLD, "level": 2, "n_tiles": 0,
+                       "count": 0, "tiles": []}
+
+
+class _StubMatcher:
+    def match_many(self, traces):
+        return [[] for _ in traces]
+
+
+@pytest.fixture
+def fresh_server(tmp_path):
+    """A served stack with the freshness tier live: the store's ingests
+    happen IN the serving process, so finite windows and delta events
+    work (the co-located-tee shape)."""
+    from reporter_tpu.service.server import ReporterService, serve
+    ds = LocalDatastore(str(tmp_path / "store"))
+    ds.enable_freshness()
+    ds.ingest_segments(_segs(20), ingest_key="seed-flush")
+    service = ReporterService(_StubMatcher(), datastore=ds)
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    httpd = serve(service, "127.0.0.1", port)
+    yield f"http://127.0.0.1:{port}", ds
+    httpd.shutdown()
+
+
+def _get(url):
+    try:
+        with urllib.request.urlopen(url) as r:
+            return r.status, json.loads(r.read()), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read()), dict(e.headers)
+
+
+class TestFreshnessHTTP:
+    def test_window_param(self, fresh_server):
+        url, _ds = fresh_server
+        code, body, _ = _get(f"{url}/histogram?segment_id={SID}&window=5m")
+        assert code == 200 and body["count"] == 20
+        code, body, _ = _get(f"{url}/histogram?bbox=-180,-90,180,90"
+                             "&level=2&window=300s")
+        assert code == 200 and body["segments"][0]["count"] == 20
+
+    def test_inf_window_byte_identical_over_http(self, fresh_server):
+        url, _ds = fresh_server
+        plain = urllib.request.urlopen(
+            f"{url}/histogram?segment_id={SID}").read()
+        merged = urllib.request.urlopen(
+            f"{url}/histogram?segment_id={SID}&window=inf").read()
+        assert merged == plain
+
+    def test_bad_window_400(self, fresh_server):
+        url, _ds = fresh_server
+        code, body, _ = _get(f"{url}/histogram?segment_id={SID}"
+                             "&window=fortnight")
+        assert code == 400 and "window" in body["error"]
+
+    def test_viewport_summaries(self, fresh_server):
+        url, _ds = fresh_server
+        code, body, _ = _get(f"{url}/histogram?viewport=1"
+                             "&bbox=-180,-90,180,90&level=2")
+        assert code == 200
+        assert body["n_tiles"] == 1 and body["count"] == 20
+        code, body, _ = _get(f"{url}/histogram?viewport=1")
+        assert code == 400 and "bbox" in body["error"]
+
+    def test_feed_delivers_seed_events(self, fresh_server):
+        url, _ds = fresh_server
+        code, body, _ = _get(f"{url}/feed?cursor=0&timeout=0.2"
+                             "&bbox=-180,-90,180,90&level=2")
+        assert code == 200
+        (ev,) = body["events"]
+        assert ev["kind"] == "delta" and SID in ev["segments"]
+        assert body["cursor"] == ev["seq"]
+
+    def test_feed_long_poll_end_to_end(self, fresh_server):
+        """The e2e freshness proof at the HTTP surface: an open /feed
+        cursor is delivered the ingest the moment it lands (condition
+        notify through the whole stack), and ``window=5m`` serves the
+        same rows immediately after."""
+        url, ds = fresh_server
+        cur = ds.freshness.feed.cursor
+        got = {}
+
+        def subscribe():
+            t0 = time.monotonic()
+            got["resp"] = _get(f"{url}/feed?cursor={cur}&timeout=30"
+                               "&bbox=-180,-90,180,90&level=2")
+            got["elapsed"] = time.monotonic() - t0
+
+        th = threading.Thread(target=subscribe)
+        th.start()
+        deadline = time.monotonic() + 10
+        while ds.freshness.feed.snapshot()["waiters"] == 0:
+            assert time.monotonic() < deadline, "no waiter registered"
+            time.sleep(0.005)
+        ds.ingest_segments(_segs(5, t0=MON_8AM + 7200),
+                           ingest_key="live-flush")
+        th.join(timeout=10)
+        assert not th.is_alive() and got["elapsed"] < 5.0
+        code, body, _ = got["resp"]
+        assert code == 200
+        (ev,) = body["events"]
+        assert ev["kind"] == "delta" and ev["rows"] == 5
+        code, body, _ = _get(f"{url}/histogram?segment_id={SID}"
+                             "&window=5m")
+        assert code == 200 and body["count"] == 25
+
+    def test_feed_waiter_shed_429_retry_after(self, fresh_server):
+        url, ds = fresh_server
+        feed = ds.freshness.feed
+        old = feed.max_waiters
+        feed.max_waiters = 0
+        try:
+            code, body, headers = _get(f"{url}/feed?cursor=0&timeout=0.1")
+            assert code == 429
+            assert body["reason"] == "feed_waiters"
+            assert headers.get("Retry-After") == str(body["retry_after_s"])
+        finally:
+            feed.max_waiters = old
+
+    def test_feed_pressure_shed_before_match_path(self, fresh_server):
+        """PR 14 integration: at the FEED_SHED_LEVEL rung the feed
+        sheds subscribers with the explicit 429 + Retry-After contract
+        — fan-out is the first load dropped under pressure."""
+        from reporter_tpu.service import admission
+        url, _ds = fresh_server
+        lad = admission.ladder()
+        lad.level = 2
+        try:
+            code, body, headers = _get(f"{url}/feed?cursor=0&timeout=0.1")
+            assert code == 429 and body["reason"] == "pressure"
+            assert "Retry-After" in headers
+        finally:
+            admission._reset_module()
+
+    def test_health_freshness_block(self, fresh_server):
+        url, ds = fresh_server
+        snap = ds.freshness.snapshot()
+        assert snap["overlay"]["entries"] == 1
+        assert snap["feed"]["cursor"] >= 1
+        assert set(snap) == {"overlay", "feed", "viewports"}
+
+
+class TestFreshnessCLI:
+    def _seed(self, tmp_path, n=5):
+        ds = LocalDatastore(str(tmp_path / "s"))
+        ds.enable_freshness()
+        ds.ingest_segments(_segs(n), ingest_key="cli-seed")
+        return ds
+
+    def test_query_window_inf_cross_process(self, tmp_path, capsys):
+        from reporter_tpu.tools import datastore_cli
+        self._seed(tmp_path)
+        assert datastore_cli.main(
+            ["query", str(tmp_path / "s"), "--segment", str(SID),
+             "--window", "inf"]) == 0
+        got = json.loads(capsys.readouterr().out.strip())
+        assert got["count"] == 5
+
+    def test_query_finite_window_needs_colocated_tee(self, tmp_path,
+                                                     capsys):
+        # a fresh CLI process has witnessed no recent ingests: finite
+        # windows are empty there (documented), ∞/windowless are not
+        from reporter_tpu.tools import datastore_cli
+        self._seed(tmp_path)
+        assert datastore_cli.main(
+            ["query", str(tmp_path / "s"), "--segment", str(SID),
+             "--window", "5m"]) == 0
+        assert json.loads(capsys.readouterr().out.strip())["count"] == 0
+
+    def test_query_bad_window_exits_cleanly(self, tmp_path, capsys):
+        from reporter_tpu.tools import datastore_cli
+        self._seed(tmp_path)
+        with pytest.raises(SystemExit):
+            datastore_cli.main(["query", str(tmp_path / "s"),
+                                "--segment", str(SID),
+                                "--window", "fortnight"])
+
+    def test_feed_tails_cross_process_commits(self, tmp_path, capsys):
+        """`datastore feed` long-polls a store another handle is
+        writing to: the in-poll store watcher surfaces the commit as a
+        tile event before the poll times out."""
+        from reporter_tpu.tools import datastore_cli
+        writer = self._seed(tmp_path)
+
+        def late_ingest():
+            time.sleep(0.4)  # after the feed's baseline scan
+            writer.ingest_segments(_segs(3, t0=MON_8AM + 3600),
+                                   ingest_key="late")
+
+        th = threading.Thread(target=late_ingest)
+        th.start()
+        try:
+            assert datastore_cli.main(
+                ["feed", str(tmp_path / "s"), "--cursor", "0",
+                 "--timeout", "15", "--max-polls", "1"]) == 0
+        finally:
+            th.join()
+        got = json.loads(capsys.readouterr().out.strip())
+        assert got["events"], "commit not delivered within one poll"
+        assert got["events"][0]["kind"] == "tile"
+        assert got["events"][0]["tile_index"] == 756425
+
+    def test_feed_timeout_line(self, tmp_path, capsys):
+        from reporter_tpu.tools import datastore_cli
+        self._seed(tmp_path)
+        assert datastore_cli.main(
+            ["feed", str(tmp_path / "s"), "--cursor", "-1",
+             "--timeout", "0.05", "--max-polls", "2"]) == 0
+        lines = capsys.readouterr().out.strip().splitlines()
+        assert len(lines) == 2
+        for line in lines:
+            got = json.loads(line)
+            assert got["timeout"] is True and got["events"] == []
+
+
+class TestWorkerTeeFreshness:
+    """The ISSUE's acceptance proof at the real producer: a probe
+    flushed by a StreamWorker's tee is (a) delivered on an open /feed
+    cursor and (b) visible via window=5m — within one tee cycle, with
+    delivery via condition notify (the subscriber blocks in poll(),
+    never sleep-polls), while windowless queries stay untouched."""
+
+    def test_tee_flush_reaches_feed_and_window(self, tmp_path):
+        from reporter_tpu.matcher import SegmentMatcher
+        from reporter_tpu.service.server import ReporterService
+        from reporter_tpu.streaming.anonymiser import Anonymiser, TileSink
+        from reporter_tpu.streaming.formatter import Formatter
+        from reporter_tpu.streaming.worker import StreamWorker, \
+            inproc_submitter
+        from reporter_tpu.synth import build_grid_city, generate_trace
+
+        city = build_grid_city(rows=10, cols=10, spacing_m=200.0, seed=5,
+                               service_road_fraction=0.0,
+                               internal_fraction=0.0)
+        service = ReporterService(SegmentMatcher(net=city),
+                                  threshold_sec=15, max_batch=64,
+                                  max_wait_ms=5.0)
+        store = LocalDatastore(str(tmp_path / "store"))
+        tier = store.enable_freshness()
+
+        rng = np.random.default_rng(9)
+        lines = []
+        for i in range(6):
+            tr = None
+            while tr is None:
+                tr = generate_trace(city, f"veh-{i}", rng, noise_m=3.0,
+                                    min_route_edges=8)
+            for p in tr.points:
+                lines.append("|".join([
+                    "x", tr.uuid, str(p["lat"]), str(p["lon"]),
+                    str(p["time"]), str(p["accuracy"])]))
+
+        def tee(_tile, segments, ingest_key=None):
+            store.ingest_segments(segments, ingest_key=ingest_key)
+
+        def run_worker(out_dir):
+            anon = Anonymiser(TileSink(str(tmp_path / out_dir)),
+                              privacy=1, quantisation=3600,
+                              source="test", tee=tee)
+            worker = StreamWorker(
+                Formatter.from_config(",sv,\\|,1,2,3,4,5"),
+                inproc_submitter(service), anon, flush_interval_s=1e9)
+            worker.run(lines)
+            assert worker.parse_failures == 0
+
+        # the open cursor: subscribed BEFORE any flush lands (no bbox
+        # filter — the synthetic city's segments live at level 1 and
+        # viewport filtering has its own tests)
+        got = {}
+
+        def subscribe():
+            got["out"] = tier.feed.poll(cursor=0, timeout_s=60)
+
+        th = threading.Thread(target=subscribe)
+        th.start()
+        deadline = time.monotonic() + 10
+        while tier.feed.snapshot()["waiters"] == 0:
+            assert time.monotonic() < deadline
+            time.sleep(0.005)
+        try:
+            run_worker("results")
+        finally:
+            th.join(timeout=30)
+        assert not th.is_alive()
+        assert got["out"]["events"], "tee flush never reached the feed"
+        ev = got["out"]["events"][0]
+        assert ev["kind"] == "delta" and ev["rows"] > 0
+
+        # the flushed probe is queryable through the 5m window NOW
+        sid = ev["segments"][0]
+        fresh = store.query(sid, window="5m")
+        assert fresh["count"] > 0
+        plain = store.query(sid)
+        assert plain["count"] >= fresh["count"]
+        assert json.dumps(store.query(sid, window="inf"),
+                          sort_keys=True) \
+            == json.dumps(plain, sort_keys=True)
+
+        # crash-restart replay: the same flushes (same deterministic
+        # ingest keys) through a restarted handle never double-count
+        rows_before = store.stats()["rows"]
+        assert rows_before > 0
+        restarted = LocalDatastore(str(tmp_path / "store"))
+        restarted.enable_freshness()
+
+        def tee2(_tile, segments, ingest_key=None,
+                 _ds=restarted):
+            _ds.ingest_segments(segments, ingest_key=ingest_key)
+
+        tee_fn = tee2
+
+        anon = Anonymiser(TileSink(str(tmp_path / "results2")),
+                          privacy=1, quantisation=3600, source="test",
+                          tee=tee_fn)
+        worker = StreamWorker(
+            Formatter.from_config(",sv,\\|,1,2,3,4,5"),
+            inproc_submitter(service), anon, flush_interval_s=1e9)
+        worker.run(lines)
+        assert restarted.stats()["rows"] == rows_before
+        assert json.dumps(restarted.query(sid, window="inf"),
+                          sort_keys=True) \
+            == json.dumps(restarted.query(sid), sort_keys=True)
+
+
+class TestFeedFanoutArtifact:
+    """The committed fan-out artifact (BENCH_FEED_r01.json), its
+    ledger normalisation, and the perf_gate leg that binds the
+    zero-silent-loss contract to it."""
+
+    def test_committed_artifact(self):
+        """The checked-in 1000-subscriber run: acceptance scale, every
+        subscriber accounted for, nothing silently lost."""
+        with open(os.path.join(REPO, "BENCH_FEED_r01.json")) as f:
+            art = json.load(f)
+        assert art["kind"] == "feed_fanout"
+        assert art["subscribers"] >= 1000
+        assert art["silent_lost"] == 0
+        assert art["errors"] == 0
+        assert art["delivered"] + art["shed"] == art["subscribers"]
+        assert art["delivery_p99_ms"] is not None
+
+    def test_ledger_entry_normalisation(self):
+        from reporter_tpu.obs import ledger
+        entry = ledger._feed_entry("BENCH_FEED_r01.json", {
+            "kind": "feed_fanout", "subscribers": 1000, "procs": 2,
+            "delivered": 1000, "shed": 0, "shed_events": 7,
+            "errors": 0, "silent_lost": 0, "fanout_ratio": 1.0,
+            "delivery_p99_ms": 950.0})
+        assert entry["kind"] == "feed_fanout"
+        assert entry["scope"] == "full"
+        assert entry["vs_baseline"] == 1.0
+        assert entry["ok"] is True
+        assert "p99_ms=950.0" in entry["context"]
+        smoke = ledger._feed_entry("BENCH_FEED_x.json", {
+            "kind": "feed_fanout", "subscribers": 128, "delivered": 120,
+            "shed": 7, "errors": 0, "silent_lost": 1,
+            "fanout_ratio": 0.9375})
+        assert smoke["scope"] == "smoke"
+        assert smoke["ok"] is False  # silent loss flips the verdict
+
+    def test_feed_kind_never_pools_with_bench(self):
+        """The fanout ratio (~1.0) must not bleed into the bench
+        vs_baseline medians perf_gate compares against."""
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        import perf_gate
+        entries = [
+            {"kind": "bench", "scope": "full", "platform": "cpu",
+             "vs_baseline": 20.0},
+            {"kind": "feed_fanout", "scope": "full", "platform": "cpu",
+             "vs_baseline": 1.0},
+        ]
+        pool = perf_gate.comparable_pool(entries, "cpu", "full")
+        assert len(pool) == 1 and pool[0]["kind"] == "bench"
+
+    def test_seeded_ledger_contains_feed(self):
+        from reporter_tpu.obs import ledger
+        entries = ledger.seed_entries(REPO)
+        feed = [e for e in entries if e["kind"] == "feed_fanout"]
+        assert feed, "committed BENCH_FEED artifacts must seed the ledger"
+        assert all(e["ok"] for e in feed)
+
+    def test_gate_passes_committed_and_fails_loss(self, tmp_path):
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        import perf_gate
+        ok, verdict = perf_gate.gate_feed(
+            os.path.join(REPO, "BENCH_FEED_r01.json"), 0.95)
+        assert ok, verdict
+        # one silently lost subscriber fails the gate whatever the ratio
+        bad = {"kind": "feed_fanout", "subscribers": 100,
+               "delivered": 99, "shed": 0, "errors": 0,
+               "silent_lost": 1, "fanout_ratio": 0.99}
+        p = tmp_path / "bad.json"
+        p.write_text(json.dumps(bad))
+        ok, verdict = perf_gate.gate_feed(str(p), 0.0)
+        assert not ok
+        reasons = " ".join(f["reason"] for f in verdict["failures"])
+        assert "zero-silent-loss" in reasons
+        # a missing category fails loudly rather than passing vacuously
+        p2 = tmp_path / "missing.json"
+        p2.write_text(json.dumps({"kind": "feed_fanout",
+                                  "subscribers": 100}))
+        ok, verdict = perf_gate.gate_feed(str(p2), 0.0)
+        assert not ok
+        assert "never counted" in verdict["failures"][0]["reason"]
+        # open accounting (a subscriber counted twice / not at all)
+        p3 = tmp_path / "open.json"
+        p3.write_text(json.dumps({"kind": "feed_fanout",
+                                  "subscribers": 100, "delivered": 90,
+                                  "shed": 0, "errors": 0,
+                                  "silent_lost": 0,
+                                  "fanout_ratio": 0.9}))
+        ok, verdict = perf_gate.gate_feed(str(p3), 0.0)
+        assert not ok
+        assert "accounting open" in verdict["failures"][0]["reason"]

@@ -1,0 +1,332 @@
+#!/usr/bin/env python
+"""Change-feed fan-out under load: N concurrent bbox subscribers
+against a pre-fork fleet while a writer process commits.
+
+The freshness tier's acceptance leg (ISSUE 18): >= 1000 concurrent
+``/feed`` long-polls held open across a ``SO_REUSEPORT`` pre-fork
+fleet, one measured store commit, and every subscriber accounted for —
+delivered the event, shed with the explicit 429 + Retry-After signal,
+or errored loudly. ``silent_lost`` (a subscriber that saw neither the
+event nor a shed signal by its deadline) must be ZERO: cursor replay
+over the event ring means a shed-then-retry subscriber still receives
+the commit it missed.
+
+Method (one fresh interpreter, prefork_bench.py's template): a seed
+flush lands in a temp store, the fleet forks with the freshness tier
+enabled, a priming loop makes one paced watcher scan happen on EVERY
+worker (so each process's store-watcher baseline predates the measured
+commit), N subscriber threads open world-bbox long-polls from
+``cursor=0``, and the writer commits once at T0. Per-subscriber
+delivery latency is ``recv - T0``; the artifact reports p50/p99 and
+``fanout_ratio = delivered / subscribers``.
+
+Prints ONE JSON line:
+    {"kind": "feed_fanout", "subscribers": N, "procs": P,
+     "waiter_cap": W, "delivered": D, "shed": S, "shed_events": SE,
+     "errors": E, "silent_lost": 0, "delivery_p50_ms": ...,
+     "delivery_p99_ms": ..., "fanout_ratio": D/N}
+
+Usage (also reachable as ``python bench.py --feed-fanout N``):
+    python tools/feed_fanout_bench.py [--feed-fanout 1000] [--procs 2]
+        [--waiters 400] [--pool 700] [--out FILE] [--min-fanout 0]
+
+``--waiters`` caps each worker's feed waiter table BELOW its likely
+subscriber share on purpose: the run must exercise the shed path
+(shed_events > 0 at full scale) and still close the accounting —
+that IS the zero-silent-loss claim. ``--min-fanout R`` gates the run
+(exit 1 when fanout_ratio < R or silent_lost/errors > 0); the default
+only gates on loss, not ratio, so CI-scale runs stay honest.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_FANOUT_SCRIPT = r"""
+import json, os, signal, socket, sys, tempfile, threading, time
+import urllib.error, urllib.request
+
+from reporter_tpu.core.osmlr import make_segment_id
+from reporter_tpu.core.types import Segment
+from reporter_tpu.datastore import LocalDatastore
+from reporter_tpu.matcher import SegmentMatcher
+from reporter_tpu.service.prefork import serve_prefork
+from reporter_tpu.service.server import ReporterService
+from reporter_tpu.synth import build_grid_city
+
+SUBSCRIBERS = {subscribers}
+PROCS = {procs}
+RAMP = {ramp}
+SUB_DEADLINE = {sub_deadline}
+
+root = tempfile.mkdtemp(prefix="feed_fanout_")
+store_dir = os.path.join(root, "store")
+sid = make_segment_id(2, 756425, 10)
+nid = make_segment_id(2, 756425, 11)
+T0H = 1483344000  # Monday 08:00 UTC
+
+
+def flush(n, start):
+    return [Segment(sid, nid, start + i * 30, start + i * 30 + 10.0,
+                    100, 0) for i in range(n)]
+
+
+# the seed flush exists BEFORE the fleet forks: it is part of every
+# worker's store-watcher baseline, so the only feed event the run can
+# produce is the measured commit below
+writer = LocalDatastore(store_dir)
+writer.ingest_segments(flush(5, T0H), ingest_key="fanout-seed")
+
+city = build_grid_city(rows=4, cols=4, spacing_m=200.0, seed=5,
+                       service_road_fraction=0.0, internal_fraction=0.0)
+
+with socket.socket() as s:
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+base = f"http://127.0.0.1:{{port}}"
+
+
+def make_service():
+    return ReporterService(SegmentMatcher(net=city),
+                           datastore=LocalDatastore(store_dir))
+
+
+result = {{}}
+
+
+def drive():
+    time.sleep(2.0)  # quiet-parent fork window
+    try:
+        _drive()
+    except Exception as e:
+        result["err"] = f"{{type(e).__name__}}: {{e}}"
+
+
+def _drive():
+    deadline = time.time() + 240
+    while True:
+        try:
+            urllib.request.urlopen(base + "/stats", timeout=5).read()
+            break
+        except Exception:
+            if time.time() > deadline:
+                result["err"] = "service never came up"
+                return
+            time.sleep(0.3)
+
+    # prime EVERY worker's store watcher: a poll lasting at least one
+    # pace slice runs watch_store on whichever proc answered, and its
+    # first scan is the silent baseline — a worker that baselined
+    # AFTER the measured commit would fold it into the baseline and
+    # never publish it (= silent loss by harness bug, not by product)
+    primed = set()
+    for _ in range(400):
+        req = urllib.request.Request(
+            base + "/feed?cursor=-1&timeout=0.5")
+        with urllib.request.urlopen(req, timeout=30) as r:
+            r.read()
+            tag = r.headers.get("X-Reporter-Proc", "p?")
+        primed.add(tag.split(":")[0])
+        if len(primed) >= PROCS:
+            break
+    if len(primed) < PROCS:
+        result["err"] = f"primed only {{sorted(primed)}} of {{PROCS}}"
+        return
+
+    lock = threading.Lock()
+    lat = []
+    states = {{"delivered": 0, "shed": 0, "error": 0, "silent": 0}}
+    shed_events = [0]
+    errs = {{}}
+    t0_box = [None]
+
+    def subscriber(i):
+        cursor, sheds = 0, 0
+        stop = time.monotonic() + SUB_DEADLINE
+        outcome = "silent"
+        while time.monotonic() < stop:
+            req = (base + f"/feed?cursor={{cursor}}"
+                   "&bbox=-180,-90,180,90&level=2&timeout=10")
+            try:
+                with urllib.request.urlopen(req, timeout=40) as r:
+                    body = json.loads(r.read())
+            except urllib.error.HTTPError as e:
+                if e.code == 429 and e.headers.get("Retry-After"):
+                    # the explicit shed signal: back off (jittered so
+                    # the retry wave does not re-stampede) and retry —
+                    # cursor replay makes the missed event recoverable
+                    sheds += 1
+                    time.sleep(0.2 + (i % 20) * 0.05)
+                    continue
+                outcome = "error"
+                with lock:
+                    errs[f"http {{e.code}}"] = \
+                        errs.get(f"http {{e.code}}", 0) + 1
+                break
+            except Exception as e:
+                outcome = "error"
+                with lock:
+                    key = type(e).__name__
+                    errs[key] = errs.get(key, 0) + 1
+                break
+            cursor = body["cursor"]
+            if body["events"]:
+                t = time.monotonic()
+                outcome = "delivered"
+                with lock:
+                    if t0_box[0] is not None:
+                        lat.append(t - t0_box[0])
+                break
+        if outcome == "silent" and sheds:
+            outcome = "shed"  # never delivered, but never silent
+        with lock:
+            states[outcome] += 1
+            shed_events[0] += sheds
+
+    threads = [threading.Thread(target=subscriber, args=(i,),
+                                daemon=True)
+               for i in range(SUBSCRIBERS)]
+    for t in threads:
+        t.start()
+    time.sleep(RAMP)  # let the long-polls establish
+
+    t0_box[0] = time.monotonic()
+    writer.ingest_segments(flush(3, T0H + 3600),
+                           ingest_key="fanout-live")
+    for t in threads:
+        t.join(timeout=SUB_DEADLINE + 60)
+    if any(t.is_alive() for t in threads):
+        result["err"] = "subscriber threads leaked past the deadline"
+        return
+
+    lat_ms = sorted(x * 1000 for x in lat)
+
+    def pct(p):
+        if not lat_ms:
+            return None
+        return round(lat_ms[min(len(lat_ms) - 1,
+                                int(p / 100 * len(lat_ms)))], 1)
+
+    result.update(
+        subscribers=SUBSCRIBERS, procs=PROCS,
+        delivered=states["delivered"], shed=states["shed"],
+        shed_events=shed_events[0], errors=states["error"],
+        error_kinds=errs, silent_lost=states["silent"],
+        delivery_p50_ms=pct(50), delivery_p99_ms=pct(99),
+        fanout_ratio=round(states["delivered"] / SUBSCRIBERS, 4))
+
+
+t = threading.Thread(target=drive, daemon=True)
+try:
+    urllib.request.urlopen(base + "/stats", timeout=0.2)
+except Exception:
+    pass  # warm the opener machinery pre-fork, in the main thread
+t.start()
+
+
+def reaper():
+    t.join()
+    os.kill(os.getpid(), signal.SIGTERM)
+
+
+threading.Thread(target=reaper, daemon=True).start()
+rc = serve_prefork(make_service, "127.0.0.1", port, PROCS)
+print("FANOUT:" + json.dumps(result))
+sys.exit(0 if "err" not in result else 1)
+"""
+
+
+def run_fanout(subscribers: int, procs: int, waiters: int, pool: int,
+               ramp: float, sub_deadline: float) -> dict:
+    script = _FANOUT_SCRIPT.format(subscribers=subscribers, procs=procs,
+                                   ramp=ramp, sub_deadline=sub_deadline)
+    env = dict(os.environ)
+    env.update(
+        REPORTER_TPU_PLATFORM="cpu",  # fan-out is an I/O bench
+        REPORTER_TPU_PREP_THREADS="1",
+        OMP_NUM_THREADS="1",
+        OPENBLAS_NUM_THREADS="1",
+        # each worker must HOLD its subscriber share as open long-polls
+        THREAD_POOL_COUNT=str(pool),
+        # per-worker feed waiter cap: sized to force the shed path at
+        # full scale so the explicit-retry contract is what's measured
+        REPORTER_TPU_FRESHNESS_WAITERS=str(waiters),
+        # tight watcher pace: delivery latency measures fan-out, not
+        # the scan timer
+        REPORTER_TPU_FRESHNESS_POLL_S="0.1")
+    proc = subprocess.run([sys.executable, "-c", script], cwd=REPO,
+                          capture_output=True, text=True, timeout=900,
+                          env=env)
+    lines = [ln for ln in proc.stdout.splitlines()
+             if ln.startswith("FANOUT:")]
+    if proc.returncode != 0 or not lines:
+        raise SystemExit(f"fanout leg failed rc={proc.returncode}: "
+                         f"{(proc.stdout + proc.stderr)[-2000:]}")
+    out = json.loads(lines[-1][len("FANOUT:"):])
+    if "err" in out:
+        raise SystemExit(f"fanout leg: {out['err']}")
+    return out
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="feed_fanout_bench",
+                                     description=__doc__.splitlines()[0])
+    parser.add_argument("--feed-fanout", dest="subscribers", type=int,
+                        default=1000, metavar="N",
+                        help="concurrent bbox subscribers (default "
+                             "1000 — the acceptance floor)")
+    parser.add_argument("--procs", type=int, default=2)
+    parser.add_argument("--waiters", type=int, default=400,
+                        help="per-worker feed waiter cap (REPORTER_TPU_"
+                             "FRESHNESS_WAITERS); below the per-worker "
+                             "subscriber share so the shed path runs")
+    parser.add_argument("--pool", type=int, default=700,
+                        help="per-worker server thread pool "
+                             "(THREAD_POOL_COUNT)")
+    parser.add_argument("--ramp", type=float, default=6.0,
+                        help="seconds between subscriber start and the "
+                             "measured commit")
+    parser.add_argument("--deadline", type=float, default=60.0,
+                        help="per-subscriber overall deadline")
+    parser.add_argument("--min-fanout", type=float, default=0.0,
+                        help="fail below this fanout_ratio (loss and "
+                             "errors always gate)")
+    parser.add_argument("--out", default=None)
+    args = parser.parse_args(argv)
+
+    res = run_fanout(args.subscribers, args.procs, args.waiters,
+                     args.pool, args.ramp, args.deadline)
+    art = {"kind": "feed_fanout", "waiter_cap": args.waiters,
+           "pool": args.pool, **res}
+    body = json.dumps(art, separators=(",", ":"))
+    print(body)
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            f.write(body)
+
+    failures = []
+    if art["silent_lost"]:
+        failures.append(f"silent_lost {art['silent_lost']} != 0")
+    if art["errors"]:
+        failures.append(f"errors {art['errors']} != 0 "
+                        f"({art['error_kinds']})")
+    accounted = art["delivered"] + art["shed"] + art["errors"] \
+        + art["silent_lost"]
+    if accounted != art["subscribers"]:
+        failures.append(f"accounting open: {accounted} != "
+                        f"{art['subscribers']} subscribers")
+    if args.min_fanout and art["fanout_ratio"] < args.min_fanout:
+        failures.append(f"fanout_ratio {art['fanout_ratio']} < floor "
+                        f"{args.min_fanout}")
+    for f in failures:
+        sys.stderr.write(f"feed_fanout_bench: FAIL: {f}\n")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

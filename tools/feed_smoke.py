@@ -1,0 +1,149 @@
+#!/usr/bin/env python
+"""CI smoke gate for the freshness tier's ingest -> subscribe loop.
+
+Drives the REAL stack end-to-end, in-process:
+
+  a served stack with the freshness tier live (store + /feed + /histogram)
+  -> a subscriber opens a ``/feed`` long-poll over HTTP
+  -> a tee-shaped ingest lands in the serving process
+  -> the subscriber must receive the delta event UNDER A DEADLINE
+     (condition-notified delivery, not sleep-polling)
+  -> ``/histogram?window=5m`` serves the same rows immediately
+  -> ``window=inf`` stays byte-identical to the windowless answer
+
+A regression anywhere on the ingest -> overlay -> feed -> HTTP path
+fails CI here, with the service surface (not just library calls) on
+the hook. ``--deadline`` bounds first-delta latency (default 2 s — one
+tee cycle is milliseconds; the bound only exists to catch a fallback
+to timer polling).
+"""
+import argparse
+import json
+import os
+import socket
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("REPORTER_TPU_PLATFORM", "cpu")  # CI: never probe
+
+
+def fail(msg: str) -> int:
+    sys.stderr.write(f"feed smoke: {msg}\n")
+    return 1
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--deadline", type=float, default=2.0,
+                        help="max seconds from ingest to delivered "
+                             "delta event")
+    args = parser.parse_args(argv)
+
+    from reporter_tpu.core.osmlr import make_segment_id
+    from reporter_tpu.core.types import Segment
+    from reporter_tpu.datastore import LocalDatastore
+    from reporter_tpu.matcher import SegmentMatcher
+    from reporter_tpu.service.server import ReporterService, serve
+    from reporter_tpu.synth import build_grid_city
+
+    sid = make_segment_id(2, 756425, 10)
+    nid = make_segment_id(2, 756425, 11)
+    t0 = 1483344000  # Monday 08:00 UTC
+
+    def flush(n, start):
+        return [Segment(sid, nid, start + i * 30,
+                        start + i * 30 + 10.0, 100, 0) for i in range(n)]
+
+    with tempfile.TemporaryDirectory() as tmp:
+        ds = LocalDatastore(os.path.join(tmp, "store"))
+        tier = ds.enable_freshness()
+        if tier is None:
+            return fail("freshness tier did not enable")
+        ds.ingest_segments(flush(5, t0), ingest_key="smoke-seed")
+
+        city = build_grid_city(rows=4, cols=4, spacing_m=200.0, seed=5,
+                               service_road_fraction=0.0,
+                               internal_fraction=0.0)
+        service = ReporterService(SegmentMatcher(net=city), datastore=ds)
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        url = f"http://127.0.0.1:{port}"
+        httpd = serve(service, "127.0.0.1", port)
+        try:
+            # 1) the seed flush is already on the feed (cursor replay)
+            with urllib.request.urlopen(
+                    f"{url}/feed?cursor=0&timeout=1", timeout=30) as r:
+                seeded = json.loads(r.read())
+            if not seeded["events"] or seeded["events"][0]["kind"] != "delta":
+                return fail(f"seed flush missing from feed: {seeded}")
+            cursor = seeded["cursor"]
+
+            # 2) subscribe first, ingest second: the open long-poll
+            # must be woken by the landing flush under the deadline
+            got = {}
+
+            def subscribe():
+                req = (f"{url}/feed?cursor={cursor}"
+                       "&bbox=-180,-90,180,90&level=2&timeout=30")
+                with urllib.request.urlopen(req, timeout=60) as r:
+                    got["body"] = json.loads(r.read())
+                got["t"] = time.monotonic()
+
+            th = threading.Thread(target=subscribe)
+            th.start()
+            waited = time.monotonic() + 10
+            while tier.feed.snapshot()["waiters"] == 0:
+                if time.monotonic() > waited:
+                    return fail("subscriber never registered as waiter")
+                time.sleep(0.005)
+            t_ingest = time.monotonic()
+            ds.ingest_segments(flush(3, t0 + 3600),
+                               ingest_key="smoke-live")
+            th.join(timeout=args.deadline + 30)
+            if th.is_alive():
+                return fail("subscriber still blocked after ingest")
+            latency = got["t"] - t_ingest
+            if latency > args.deadline:
+                return fail(f"first delta took {latency:.3f}s "
+                            f"(deadline {args.deadline}s) — is delivery "
+                            "sleep-polling?")
+            events = got["body"]["events"]
+            if not events or events[0]["kind"] != "delta" \
+                    or sid not in events[0]["segments"]:
+                return fail(f"wrong event delivered: {got['body']}")
+
+            # 3) the freshness window serves the new rows NOW
+            with urllib.request.urlopen(
+                    f"{url}/histogram?segment_id={sid}&window=5m",
+                    timeout=30) as r:
+                windowed = json.loads(r.read())
+            if windowed["count"] != 8:
+                return fail(f"window=5m count {windowed['count']} != 8")
+
+            # 4) ∞-parity: merged reads byte-identical to windowless
+            plain = urllib.request.urlopen(
+                f"{url}/histogram?segment_id={sid}", timeout=30).read()
+            merged = urllib.request.urlopen(
+                f"{url}/histogram?segment_id={sid}&window=inf",
+                timeout=30).read()
+            if plain != merged:
+                return fail("window=inf diverged from windowless bytes")
+        finally:
+            httpd.shutdown()
+
+        print(f"feed smoke ok: seed delivered at cursor {cursor}, "
+              f"live delta in {latency * 1000:.1f} ms "
+              f"(deadline {args.deadline}s), window=5m count=8, "
+              "inf==windowless bytes")
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -33,6 +33,10 @@ Usage:
     # against the median of the rest (the CI sanity leg)
     python tools/perf_gate.py --self-check
 
+    # change-feed fan-out: zero-silent-loss accounting + fanout floor
+    # over a tools/feed_fanout_bench.py artifact (ISSUE 18)
+    python tools/perf_gate.py --feed BENCH_FEED_r01.json
+
 Exit 0 prints the verdict JSON with ``"pass": true``; any regression
 prints the offending comparison and exits 1. An empty comparable pool
 passes with a note (bootstrap-friendly) unless ``--require-history``.
@@ -357,6 +361,68 @@ def gate_bigreplay(path: str, min_ratio: float) -> Tuple[bool, dict]:
     return (not verdict["failures"]), verdict
 
 
+def gate_feed(path: str, min_fanout: float) -> Tuple[bool, dict]:
+    """Gate a tools/feed_fanout_bench.py artifact: the zero-silent-loss
+    contract (ISSUE 18). Every subscriber must be accounted for —
+    delivered, shed with the explicit 429 + Retry-After signal, or an
+    error — with ``silent_lost == 0`` and ``errors == 0``; the
+    accounting must close (delivered + shed + errors + silent_lost ==
+    subscribers); and ``fanout_ratio`` must hold ``min_fanout``. A
+    missing field fails loudly — an artifact that never counted a
+    category must not pass a gate about counting."""
+    with open(path, encoding="utf-8") as f:
+        art = json.load(f)
+    if art.get("kind") != "feed_fanout":
+        raise SystemExit(f"{path} is not a feed_fanout artifact")
+    verdict = {
+        "candidate": {"source": os.path.basename(path),
+                      "kind": "feed_fanout",
+                      "subscribers": art.get("subscribers"),
+                      "procs": art.get("procs"),
+                      "delivery_p99_ms": art.get("delivery_p99_ms")},
+        "fanout_ratio": art.get("fanout_ratio"),
+        "min_fanout_ratio": min_fanout,
+        "failures": [],
+    }
+    fields = ("subscribers", "delivered", "shed", "errors",
+              "silent_lost", "fanout_ratio")
+    missing = [k for k in fields if art.get(k) is None]
+    if missing:
+        verdict["failures"].append(
+            {"check": "feed", "reason": "artifact is missing "
+             f"{missing} — a category that was never counted cannot "
+             "pass a loss gate"})
+        return False, verdict
+    if art["silent_lost"]:
+        verdict["failures"].append(
+            {"check": "feed", "candidate": art["silent_lost"],
+             "floor": 0,
+             "reason": f"{art['silent_lost']} subscriber(s) saw "
+             "neither the event nor an explicit shed signal — the "
+             "zero-silent-loss contract is broken"})
+    if art["errors"]:
+        verdict["failures"].append(
+            {"check": "feed", "candidate": art["errors"], "floor": 0,
+             "reason": f"{art['errors']} subscriber(s) errored "
+             f"({art.get('error_kinds')})"})
+    accounted = art["delivered"] + art["shed"] + art["errors"] \
+        + art["silent_lost"]
+    if accounted != art["subscribers"]:
+        verdict["failures"].append(
+            {"check": "feed", "candidate": accounted,
+             "floor": art["subscribers"],
+             "reason": f"accounting open: delivered+shed+errors+lost "
+             f"= {accounted} != {art['subscribers']} subscribers"})
+    if art["fanout_ratio"] < min_fanout:
+        verdict["failures"].append(
+            {"check": "feed", "candidate": art["fanout_ratio"],
+             "floor": min_fanout,
+             "reason": f"fanout_ratio {art['fanout_ratio']} < floor "
+             f"{min_fanout}: the measured commit did not reach enough "
+             "of the subscriber fleet"})
+    return (not verdict["failures"]), verdict
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="perf_gate",
                                      description=__doc__.splitlines()[0])
@@ -374,6 +440,14 @@ def main(argv=None) -> int:
                         help="multichip_bench artifact: gate every "
                         "device-count throughput ratio against "
                         "--min-device-ratio")
+    parser.add_argument("--feed",
+                        help="feed_fanout_bench artifact: gate the "
+                        "zero-silent-loss accounting and fanout ratio "
+                        "against --min-fanout-ratio")
+    parser.add_argument("--min-fanout-ratio", type=float, default=0.95,
+                        help="floor for delivered/subscribers in the "
+                        "--feed gate (default 0.95; loss and errors "
+                        "gate at zero regardless)")
     parser.add_argument("--min-device-ratio", type=float, default=0.5,
                         help="floor for each N-device over 1-device "
                         "throughput ratio (default 0.5: a CPU box's "
@@ -421,7 +495,7 @@ def main(argv=None) -> int:
             parser.error(f"--max-share wants STAGE=CEIL, got {spec!r}")
     if (max_shares or args.max_padding_waste is not None
             or args.min_query_ratio is not None) \
-            and (args.bigreplay or args.multichip):
+            and (args.bigreplay or args.multichip or args.feed):
         # those artifacts carry no stage shares / bucketing block —
         # refuse loudly rather than silently ignoring a ceiling the
         # caller believes binds
@@ -431,6 +505,15 @@ def main(argv=None) -> int:
     if args.bigreplay:
         passed, verdict = gate_bigreplay(args.bigreplay,
                                          args.min_fault_ratio)
+        verdict["pass"] = passed
+        print(json.dumps(verdict, separators=(",", ":")))
+        if not passed:
+            for f in verdict["failures"]:
+                sys.stderr.write(f"perf_gate: FAIL: {f['reason']}\n")
+        return 0 if passed else 1
+
+    if args.feed:
+        passed, verdict = gate_feed(args.feed, args.min_fanout_ratio)
         verdict["pass"] = passed
         print(json.dumps(verdict, separators=(",", ":")))
         if not passed:
@@ -472,8 +555,9 @@ def main(argv=None) -> int:
                                args.share_tolerance,
                                args.require_history)
     else:
-        parser.error("need --candidate FILE, --self-check or "
-                     "--bigreplay FILE")
+        parser.error("need --candidate FILE, --self-check, "
+                     "--bigreplay FILE, --multichip FILE or "
+                     "--feed FILE")
         return 2  # unreachable; parser.error exits
 
     if max_shares:  # absolute ceilings, on top of the median gate
