@@ -781,6 +781,14 @@ def main():
 
 
 if __name__ == "__main__":
+    if "--streaming" in sys.argv[1:]:
+        # the incremental matcher's per-appended-point leg (ISSUE 19)
+        # times growing windows, not bulk replays — its own module,
+        # reachable as `python bench.py --streaming` for one-command
+        # symmetry with the throughput legs
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from tools import stream_bench
+        sys.exit(stream_bench.main(sys.argv[1:]))
     if "--feed-fanout" in sys.argv[1:]:
         # the freshness tier's fan-out leg (ISSUE 18) lives in its own
         # module — a serving bench like tools/prefork_bench.py, not a

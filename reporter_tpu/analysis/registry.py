@@ -93,6 +93,9 @@ ENV_KNOBS: Dict[str, str] = {
     "REPORTER_TPU_FRESHNESS_MB": "recent-delta overlay byte budget (MB)",
     "REPORTER_TPU_FRESHNESS_WAITERS": "/feed long-poll waiter cap (shed past)",
     "REPORTER_TPU_FRESHNESS_POLL_S": "feed store-watch pace (cross-process)",
+    "REPORTER_TPU_INCREMENTAL": "incremental matcher path (off disables)",
+    "REPORTER_TPU_INCREMENTAL_LAG": "fixed-lag commit bound, kept points",
+    "REPORTER_TPU_INCREMENTAL_MB": "carried-state table byte budget (MB)",
 }
 
 # ---- metric names ----------------------------------------------------------
@@ -213,6 +216,10 @@ METRICS: Dict[str, str] = {
     "decode.shadow.dropped": "shadow chunks shed (sampler backlogged)",
     "decode.shadow.errors": "shadow decode failures (chunk skipped)",
     "profile.chunks": "wide events recorded",
+    # incremental matcher (ISSUE 19: matcher/incremental.py)
+    "match.incremental.*": "carried-state path: steps/commits/matches/"
+                           "state_bytes/evictions/fallbacks/resets/"
+                           "shadow checks + the advance/decode timers",
     # runtime concurrency witness (analysis/racecheck.py)
     "racecheck.findings": "witness/audit findings, all RC rules",
     "racecheck.*": "per-rule finding counts (RC001-RC004)",
@@ -239,6 +246,8 @@ FAULT_SITES: Dict[str, str] = {
     "wire.native": "native wire-writer fault -> Python writer, same bytes",
     "admission.gate": "gate/sensor failure -> fail OPEN (admit), counted",
     "route.device": "device route fill error -> native re-prep with routes",
+    "match.incremental.commit": "crash/error at a fixed-lag commit -> "
+                                "carried state dropped, batch-path replay",
 }
 
 # ---- durable layout roots --------------------------------------------------
@@ -310,6 +319,9 @@ KERNEL_CONTRACTS: Dict[str, str] = {
     "reporter_tpu/parallel/sharded.py::viterbi_assoc_batch":
         "mesh-sharded re-jit of assoc decode (signature owned by "
         "ops/assoc_viterbi.py; needs a Mesh, no stand-alone eval cases)",
+    "reporter_tpu/ops/incremental.py::incremental_step_batch":
+        "one-point incremental Viterbi advance -> (N,K) scores + bp "
+        "+ (N,) restart anchors",
 }
 
 # ---- device lanes / host-sync whitelist (DP rules) -------------------------
@@ -368,6 +380,12 @@ FALLBACK_PAIRS: Dict[str, Dict[str, str]] = {
         "knob": "REPORTER_TPU_WIRE_NATIVE",
         "parity_test": "tests/test_report_writer.py::"
                        "test_wire_cross_path_property",
+    },
+    "matcher.circuit.incremental": {  # carried-state <-> windowed batch
+        "fault_site": "match.incremental.commit",
+        "knob": "REPORTER_TPU_INCREMENTAL",
+        "parity_test": "tests/test_incremental.py::"
+                       "test_incremental_matches_batch_noise_profiles",
     },
 }
 

@@ -91,6 +91,16 @@ def _decode_cases(d, extra_statics=None):
     return out
 
 
+def _incremental_cases(d):
+    """(dist_m, valid, route_m, gc_m, case, prev_scores, sigma, beta) —
+    one appended kept point for N carried traces (no time axis; N plays
+    the batch role B plays in the windowed decode)."""
+    N, K = d["B"], d["K"]
+    return [([((N, K), _F32), ((N, K), _BOOL), ((N, K, K), _F32),
+              ((N,), _F32), ((N,), _I32), ((N, K), _F32),
+              ((), _F32), ((), _F32)], {})]
+
+
 def _relax_cases(d):
     E, S, N = d["E"], d["S"], d["N"]
     return [([((E,), _I32), ((E,), _I32), ((E,), _F32), ((E,), _F32),
@@ -136,6 +146,8 @@ _EVAL_SPECS = {
     "reporter_tpu/ops/pallas_viterbi.py::viterbi_pallas_batch":
         lambda d: _decode_cases(d, {"interpret": True}),
     "reporter_tpu/matcher/hmm.py::viterbi_decode_batch": _decode_cases,
+    "reporter_tpu/ops/incremental.py::incremental_step_batch":
+        _incremental_cases,
 }
 
 

@@ -443,6 +443,8 @@ class SegmentMatcher:
         #                     assembly with poisoned-trace quarantine
         #   circuit_route     device route kernel -> native re-prep with
         #                     host routes (batchpad.prepare_batch)
+        #   circuit_incremental  carried-state incremental decode ->
+        #                     whole-window batch re-decode (match_many)
         # Fallback outputs are pinned byte-identical (tests/
         # test_report_writer.py, TestDecodeDomain); a half-open probe
         # after the cooldown feels out recovery. The breakers exist even
@@ -464,6 +466,13 @@ class SegmentMatcher:
         self.circuit_route = CircuitBreaker("matcher.circuit.route",
                                             threshold=threshold,
                                             cooldown_s=cooldown)
+        self.circuit_incremental = CircuitBreaker(
+            "matcher.circuit.incremental",
+            threshold=threshold, cooldown_s=cooldown)
+        # carried per-trace decode state for the incremental path
+        # (matcher/incremental.py); built lazily — batch-only callers
+        # never pay for the table
+        self._incremental_table = None
         # device route kernel (REPORTER_TPU_ROUTE_DEVICE): built lazily
         # on the first native dispatch — jax import + column upload are
         # not a cost the numpy-only paths should pay. False = build
@@ -512,13 +521,24 @@ class SegmentMatcher:
                     self._route_cache = RouteCache(self.net)
         return self._route_cache
 
+    @property
+    def incremental_table(self):
+        """The carried per-trace decode state table (built on first use)."""
+        if self._incremental_table is None:
+            with self._fallback_lock:
+                if self._incremental_table is None:
+                    from .incremental import IncrementalTable
+                    self._incremental_table = IncrementalTable(self)
+        return self._incremental_table
+
     # -- failure-domain surface --------------------------------------------
     #: domain name -> breaker attribute; the /health "degraded" block,
     #: the worker heartbeat and the chaos assertions all read this map
     CIRCUIT_DOMAINS = (("native.prep", "circuit"),
                        ("decode.dispatch", "circuit_decode"),
                        ("matcher.assemble", "circuit_assemble"),
-                       ("route.device", "circuit_route"))
+                       ("route.device", "circuit_route"),
+                       ("match.incremental", "circuit_incremental"))
 
     def _device_route_kernel(self):
         """The lazily-built device route kernel, or None when disabled,
@@ -677,6 +697,56 @@ class SegmentMatcher:
                     first_err = e
         if first_err is not None:
             raise first_err
+        return results
+
+    def match_incremental(self, traces) -> List[Optional[dict]]:
+        """Match via carried per-trace decode state where possible.
+
+        Same input contract as :meth:`match_many`, but each trace with a
+        uuid advances its carried decode state by the points appended
+        since its last report — O(K) device work per appended point
+        instead of a whole-window re-decode. Returns match dicts in
+        order with ``None`` for every trace the incremental path did not
+        serve (no uuid, kill switch/pressure shed, open circuit, parity
+        fallback, eviction, error) — callers route those through
+        :meth:`match_many`, whose output is byte-identical by
+        construction (tests/test_incremental.py pins this).
+        """
+        from . import incremental as _inc
+        tb = as_trace_batch(traces)
+        ntr = len(tb)
+        results: List[Optional[dict]] = [None] * ntr
+        if ntr == 0:
+            return results
+        if not _inc.incremental_enabled() or _inc.pressure_shed():
+            if self._incremental_table is not None:
+                self._incremental_table.clear()
+            return results
+        if not self.circuit_incremental.allow():
+            metrics.count("match.incremental.circuit_skips")
+            return results
+        opts = tb.options
+        if opts is None:
+            per_trace_params = [self.params] * ntr
+        elif isinstance(opts, dict):
+            per_trace_params = [self.params.with_options(opts)] * ntr
+        else:
+            per_trace_params = [
+                self.params.with_options(o) if o else self.params
+                for o in opts]
+        try:
+            with metrics.timer("match.incremental.advance"):
+                failures = self.incremental_table.match_many(
+                    tb, per_trace_params, results)
+        except Exception as e:
+            self.circuit_incremental.record_failure()
+            logger.warning("incremental match failed (%s); the batch "
+                           "path serves this report", e)
+            return [None] * ntr
+        if failures:
+            self.circuit_incremental.record_failure()
+        else:
+            self.circuit_incremental.record_success()
         return results
 
     @staticmethod

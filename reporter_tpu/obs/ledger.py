@@ -241,6 +241,41 @@ def _feed_entry(source: str, d: dict) -> dict:
                        f" p99_ms={d.get('delivery_p99_ms')}"}
 
 
+def _stream_entry(source: str, d: dict) -> dict:
+    """One ledger entry from a tools/stream_bench.py artifact (the
+    ISSUE 19 incremental-matcher leg). ``vs_baseline`` holds the
+    flatness ratio — per-appended-point decode p99 at the longest
+    window over the shortest, <= 1.5 meaning the carried-state cost is
+    flat in T while the context's ``growth`` shows the whole-window
+    path scaling with it — and ``ok`` pins the zero-parity-mismatch
+    contract. Kind ``streaming`` is excluded from the bench comparable
+    pool (tools/perf_gate.py ``comparable_pool``); gate with
+    ``perf_gate --streaming`` instead. Scope follows the longest
+    window: the T=256 acceptance leg is ``full``, shorter smoke runs
+    are ``smoke``."""
+    legs = d.get("legs") or {}
+    t_max = max((int(t) for t in legs), default=0)
+    big = legs.get(str(t_max), {})
+    return {"source": source,
+            "label": source.replace("BENCH_", "").replace(".json", ""),
+            "kind": "streaming",
+            "scope": "full" if t_max >= 256 else "smoke",
+            "platform": "cpu", "decode": "incremental",
+            "pipelined": None,
+            "vs_baseline": d.get("flatness_ratio"),
+            "traces_per_sec": None,
+            "baseline_tps": None, "stage_shares": None,
+            "n_devices": None,
+            "ok": d.get("parity_mismatches") == 0,
+            "context": f"windows={sorted(int(t) for t in legs)}"
+                       f" lag={d.get('lag')}"
+                       f" dec_p99_ms={big.get('dec_p99_ms')}"
+                       f" match_p99_ms={big.get('inc_p99_ms')}"
+                       f" growth={d.get('batch_growth')}"
+                       f" speedup_p50={d.get('speedup_p50_at_256')}"
+                       f" mismatches={d.get('parity_mismatches')}"}
+
+
 def seed_entries(repo: str) -> List[dict]:
     """Normalise every checked-in perf artifact into ledger entries."""
     entries: List[dict] = []
@@ -362,6 +397,14 @@ def seed_entries(repo: str) -> List[dict]:
         with open(path, encoding="utf-8") as f:
             d = json.load(f)
         entries.append(_feed_entry(os.path.basename(path), d))
+
+    # incremental-matcher streaming verdicts (ISSUE 19): per-appended-
+    # point decode flatness + parity against the whole-window oracle
+    for path in sorted(glob.glob(os.path.join(repo,
+                                              "BENCH_STREAM_r*.json"))):
+        with open(path, encoding="utf-8") as f:
+            d = json.load(f)
+        entries.append(_stream_entry(os.path.basename(path), d))
     return entries
 
 

@@ -22,6 +22,7 @@ import os
 import jax
 
 from .assoc_viterbi import step_matrices, viterbi_assoc_batch
+from .incremental import incremental_step_batch
 from .pallas_viterbi import (
     VMEM_BUDGET_BYTES,
     viterbi_pallas_batch,
@@ -29,8 +30,8 @@ from .pallas_viterbi import (
 )
 
 __all__ = ["viterbi_assoc_batch", "viterbi_pallas_batch", "step_matrices",
-           "decode_batch", "batch_pad_multiple", "decode_mesh_size",
-           "shard_width"]
+           "incremental_step_batch", "decode_batch", "batch_pad_multiple",
+           "decode_mesh_size", "shard_width"]
 
 # a forked worker must re-derive its device slice and jitted runs (the
 # parent's mesh names devices the child's slice may not own); prefork

@@ -279,6 +279,8 @@ def _apply_level(level: int) -> None:
     global _trace_shed
     with _module_lock:
         _trace_shed = level >= 2
+    from ..matcher import incremental
+    incremental.set_pressure_shed(level >= 2)
     from ..matcher import batchpad
     batchpad.set_pressure_coarse(level >= 3)
     from ..matcher import matcher as matcher_mod

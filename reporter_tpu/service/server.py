@@ -362,6 +362,16 @@ class ReporterService:
             # REPORTER_TPU_SLO_MS to make a mismatch rate flip 503)
             "shadow": profiler.shadow_stats(),
         }
+        # carried-state gauge (matcher/incremental.py): table occupancy
+        # vs its byte budget, lag bound, eviction/fallback/reset
+        # counters — zeros until the first incremental report builds the
+        # table (batch-only deployments never pay for it)
+        from ..matcher import incremental as _inc
+        body["incremental"] = {
+            "enabled": _inc.incremental_enabled()
+            and not _inc.pressure_shed()}
+        if m._incremental_table is not None:
+            body["incremental"].update(m._incremental_table.gauge())
         # load-management view (ISSUE 15): the degradation-ladder state
         # plus — when the gate is armed — its live sensors and per-
         # reason shed counters. Informational: a shedding service is
@@ -417,6 +427,48 @@ class ReporterService:
         body["status"] = "ok" if healthy else "degraded"
         return (200 if healthy else 503,
                 json.dumps(body, separators=(",", ":")))
+
+    def report_incremental(self, traces) -> list:
+        """:meth:`report_many` with the carried-state fast path: traces
+        the incremental matcher serves (O(K) device work per appended
+        point) skip the whole-window dispatcher round trip; every slot
+        it declines — no uuid, kill switch, pressure shed, open
+        circuit, parity fallback, eviction — rides ONE batched
+        :meth:`report_many` call instead. The per-slot reports are
+        byte-identical either way (the incremental path's match dicts
+        are pinned to the batch oracle), so callers cannot tell which
+        path served them except by latency and the
+        ``match.incremental.*`` counters."""
+        import logging
+        from ..core.tracebatch import as_trace_batch
+        log = logging.getLogger("reporter_tpu.service")
+        tb = as_trace_batch(traces)
+        try:
+            matches = self.matcher.match_incremental(tb)
+        except Exception as e:   # defensive: match_incremental degrades
+            log.error("incremental match failed (%s); the batch path "
+                      "serves this flush", e)
+            matches = [None] * len(tb)
+        unserved = [i for i, mt in enumerate(matches) if mt is None]
+        if len(unserved) == len(tb):
+            return self.report_many(tb)
+        out: list = [None] * len(tb)
+        if unserved:
+            for j, rep in zip(unserved, self.report_many(tb.gather(unserved))):
+                out[j] = rep
+        for i, mt in enumerate(matches):
+            if mt is None:
+                continue
+            trace = tb[i]
+            try:
+                opts = trace["match_options"]
+                out[i] = report(mt, trace, self.threshold_sec,
+                                set(opts["report_levels"]),
+                                set(opts["transition_levels"]))
+            except Exception as e:
+                log.error("report build failed for %s: %s",
+                          trace.get("uuid"), e)
+        return out
 
     def report_many(self, traces) -> list:
         """Match + report a whole list — or a columnar

@@ -225,8 +225,14 @@ class PointBatcher:
                  report_flush: int = 64,
                  retry_budget: Optional[int] = None,
                  deadletter_dir: Optional[str] = None,
-                 governor: Optional[BackpressureGovernor] = None):
+                 governor: Optional[BackpressureGovernor] = None,
+                 on_evict: Optional[Callable[[str], None]] = None):
         self.submit = submit
+        # session-end hook: called once per uuid evicted at the session
+        # gap, AFTER its final relaxed-threshold report flushed — the
+        # worker wires this to the matcher's carried-state eviction so
+        # incremental decode state dies with the session, not the budget
+        self.on_evict = on_evict
         # batched submit for flush paths (one device batch for a whole
         # punctuate/pending flush); falls back to per-uuid submit
         self.submit_many = submit_many or (
@@ -439,6 +445,7 @@ class PointBatcher:
         per trace, Batch.java:66-68).
         """
         due = []
+        evicted = []
         for uuid in list(self.store):
             batch = self.store[uuid]
             if stream_time_ms - batch.last_update > self.session_gap_ms:
@@ -448,6 +455,7 @@ class PointBatcher:
                 # (its dead-letter path re-accounts it if the final
                 # report fails too)
                 self._retrying.pop(uuid, None)
+                evicted.append(uuid)
                 if batch.should_report(0, 2, 0):
                     due.append((uuid, batch))
         for uuid in self.pending:  # still live, thresholds crossed
@@ -457,3 +465,11 @@ class PointBatcher:
                 due.append((uuid, batch))
         self.pending.clear()
         self._flush_due(due)
+        if self.on_evict is not None:
+            # after the flush: the session's FINAL report still rides
+            # its carried incremental state; only then is it dropped
+            for uuid in evicted:
+                try:
+                    self.on_evict(uuid)
+                except Exception as e:
+                    logger.error("on_evict failed for %s: %s", uuid, e)

@@ -30,9 +30,12 @@ crash *before* the marker re-emits the epoch under the same names,
 which the file sink overwrites byte-identically and remote sinks dedupe
 on — every window is covered.
 
-Layout (little-endian, "RTS1" magic, version 2; v1 snapshots predate the
+Layout (little-endian, "RTS1" magic, version 3; v1 snapshots predate the
 flush epoch and are discarded as corrupt — the reference's crash
-semantics, one replay window wide):
+semantics, one replay window wide. v2 snapshots are READ compatibly:
+they simply predate the incremental section, which is a pure work-saving
+cache — restoring none of it just means those traces re-decode their
+window on the next report):
 
   header:  4s magic | u32 version | u64 snapshot_unix_ms
   epoch:   u64 flush_epoch
@@ -46,6 +49,11 @@ semantics, one replay window wide):
   slices:  u32 count, then per slice:
            u16 name_len | name utf-8 | u32 n_segments | n * Segment
   slice_of: u32 count, then per tile: Tile | u32 slice_no
+  incremental (v3+): u32 count, then per uuid:
+           u16 uuid_len | uuid utf-8 | u32 blob_len | blob
+           (opaque CarriedState frames, matcher/incremental.py serde —
+           crash-restore resumes mid-stream incremental decode instead
+           of paying a full-window replay per live session)
 """
 from __future__ import annotations
 
@@ -63,7 +71,7 @@ from .anonymiser import Anonymiser
 logger = logging.getLogger("reporter_tpu.streaming")
 
 _MAGIC = b"RTS1"
-_VERSION = 2
+_VERSION = 3
 _HEADER = struct.Struct("<4sIQ")
 _U16 = struct.Struct("<H")
 _U32 = struct.Struct("<I")
@@ -102,7 +110,10 @@ class _Reader:
         return self.take(self.u16()).decode("utf-8")
 
 
-def snapshot_bytes(batcher: PointBatcher, anonymiser: Anonymiser) -> bytes:
+def snapshot_bytes(batcher: PointBatcher, anonymiser: Anonymiser,
+                   incremental=None) -> bytes:
+    """``incremental`` is [(uuid, blob)] carried-state frames
+    (IncrementalTable.to_blobs()), or None for an empty section."""
     out = bytearray()
     out += _HEADER.pack(_MAGIC, _VERSION, int(time.time() * 1000))
     out += _U64.pack(anonymiser.flush_epoch)
@@ -130,18 +141,27 @@ def snapshot_bytes(batcher: PointBatcher, anonymiser: Anonymiser) -> bytes:
     for tile, slice_no in anonymiser.slice_of.items():
         out += tile.to_bytes()
         out += _U32.pack(slice_no)
+
+    frames = incremental or []
+    out += _U32.pack(len(frames))
+    for uuid, blob in frames:
+        _pack_str(out, uuid)
+        out += _U32.pack(len(blob))
+        out += blob
     return bytes(out)
 
 
 def restore_bytes(raw: bytes, batcher: PointBatcher,
-                  anonymiser: Anonymiser) -> None:
-    """Populate the two stores from a snapshot. Raises ValueError on a
-    corrupt/truncated snapshot — in that case the stores are left
-    UNTOUCHED (the whole snapshot is parsed before anything is applied),
-    so callers can safely treat the failure as "no snapshot"."""
+                  anonymiser: Anonymiser) -> list:
+    """Populate the two stores from a snapshot; returns the carried
+    incremental-state frames as [(uuid, blob)] (empty for a v2
+    snapshot). Raises ValueError on a corrupt/truncated snapshot — in
+    that case the stores are left UNTOUCHED (the whole snapshot is
+    parsed before anything is applied), so callers can safely treat the
+    failure as "no snapshot"."""
     r = _Reader(raw)
     magic, version, _ts = _HEADER.unpack(r.take(_HEADER.size))
-    if magic != _MAGIC or version != _VERSION:
+    if magic != _MAGIC or version not in (2, _VERSION):
         raise ValueError(f"bad snapshot header {magic!r} v{version}")
     flush_epoch = r.u64()
 
@@ -173,12 +193,19 @@ def restore_bytes(raw: bytes, batcher: PointBatcher,
         tile = TimeQuantisedTile.from_bytes(r.take(TimeQuantisedTile.SIZE))
         slice_of[tile] = r.u32()
 
+    frames = []
+    if version >= 3:
+        for _ in range(r.u32()):
+            uuid = r.string()
+            frames.append((uuid, r.take(r.u32())))
+
     # parse succeeded in full — apply atomically
     batcher.store.update(store)
     batcher.pending.update(pending)
     anonymiser.slices.update(slices)
     anonymiser.slice_of.update(slice_of)
     anonymiser.flush_epoch = flush_epoch
+    return frames
 
 
 class StateStore:
@@ -190,11 +217,16 @@ class StateStore:
     """
 
     def __init__(self, path: str, interval_s: float = 30.0,
-                 clock=time.time):
+                 clock=time.time, incremental=None):
         self.path = path
         self.interval_s = interval_s
         self.clock = clock
         self._last_save = clock()
+        # zero-arg callable -> matcher.incremental.IncrementalTable (or
+        # None): every save tees the carried decode state into the
+        # snapshot and restore hands the frames back, so a crash-restored
+        # worker resumes mid-stream incremental decode (snapshot v3)
+        self.incremental = incremental
         parent = os.path.dirname(os.path.abspath(path))
         os.makedirs(parent, exist_ok=True)
 
@@ -233,12 +265,18 @@ class StateStore:
             self._seed_epoch(anonymiser)
             return False
         try:
-            restore_bytes(raw, batcher, anonymiser)
+            frames = restore_bytes(raw, batcher, anonymiser)
         except ValueError as e:
             logger.error("Discarding corrupt state snapshot %s: %s",
                          self.path, e)
             self._seed_epoch(anonymiser)
             return False
+        if frames:
+            table = self.incremental() if self.incremental else None
+            if table is not None:
+                n = table.restore_blobs(frames)
+                logger.info("Restored %d/%d carried incremental decode "
+                            "states", n, len(frames))
         committed = self.committed_epoch()
         if committed >= anonymiser.flush_epoch:
             dropped = len(anonymiser.slices)
@@ -272,11 +310,14 @@ class StateStore:
 
     def save(self, batcher: PointBatcher, anonymiser: Anonymiser) -> None:
         faults.failpoint("state.save")
+        table = self.incremental() if self.incremental else None
+        frames = table.to_blobs() if table is not None else None
         # tmp + fsync + replace + dir fsync via fsio: os.replace
         # promises atomicity, not durability — after a power loss an
         # un-fsynced rename can legally surface as an EMPTY new name
         fsio.atomic_write_bytes(self.path,
-                                snapshot_bytes(batcher, anonymiser))
+                                snapshot_bytes(batcher, anonymiser,
+                                               incremental=frames))
         faults.failpoint("state.save", after=True)
         self._last_save = self.clock()
 

@@ -103,6 +103,9 @@ class StreamWorker:
                  trace_deadletter: Optional[str] = None,
                  circuit_probe: Optional[Callable[[], str]] = None,
                  degraded_probe: Optional[Callable[[], list]] = None,
+                 incremental_probe: Optional[
+                     Callable[[], Optional[dict]]] = None,
+                 on_evict: Optional[Callable[[str], None]] = None,
                  datastore=None, compactor=None):
         self.formatter = formatter
         # multi-host: predicate deciding which uuids this worker owns
@@ -156,7 +159,7 @@ class StreamWorker:
             submit, lambda key, seg: self.anonymiser.process(key, seg),
             mode=mode, report_on=reports, transition_on=transitions,
             session_gap_ms=session_gap_ms, submit_many=submit_many,
-            deadletter_dir=trace_deadletter)
+            deadletter_dir=trace_deadletter, on_evict=on_evict)
         self.flush_interval_s = flush_interval_s
         self.session_gap_ms = session_gap_ms
         self.clock = clock
@@ -182,6 +185,10 @@ class StreamWorker:
         # degraded_probe names the OPEN domains (matcher.open_domains)
         self.circuit_probe = circuit_probe
         self.degraded_probe = degraded_probe
+        # carried-state gauge for the incremental matcher path
+        # (IncrementalTable.gauge(); None until the table exists / on
+        # HTTP split deployments, which have no in-process matcher)
+        self.incremental_probe = incremental_probe
         self._hb_last = time.monotonic()
         self._hb_processed = 0
         # background compaction (datastore/compactor.py): the delta-
@@ -325,6 +332,12 @@ class StreamWorker:
             # before a dashboard is opened
             "pressure": _pressure_level(),
             "backpressure": self.batcher.governor.snapshot(),
+            # carried incremental decode state (ISSUE 19): live traces,
+            # state bytes vs budget, eviction/fallback counters — the
+            # per-worker view of match.incremental.* (None = no probe
+            # wired, or the table was never built)
+            "incremental": self.incremental_probe()
+            if self.incremental_probe else None,
         }, separators=(",", ":")))
 
     def _flush_tiles(self) -> None:
@@ -522,6 +535,9 @@ def main(argv=None):
 
     circuit_probe = None
     degraded_probe = None
+    incremental_probe = None
+    incremental_provider = None
+    on_evict = None
     if args.reporter_url:
         submit = http_submitter(args.reporter_url)
         submit_many = None  # HTTP path: one POST per trace (split deploy)
@@ -535,15 +551,35 @@ def main(argv=None):
             SegmentMatcher(net=RoadNetwork.load(args.graph)))
         submit = inproc_submitter(service)
         # batched submit for eviction flushes: one dispatcher round trip
-        # -> one padded device batch (ReporterService.report_many)
-        submit_many = service.report_many
+        # -> one padded device batch. report_incremental routes report-
+        # ready sessions through the carried-state path (O(K) per
+        # appended point, ISSUE 19) and falls back to the windowed
+        # report_many per trace — kill switch REPORTER_TPU_INCREMENTAL
+        submit_many = service.report_incremental
         circuit_probe = lambda: service.matcher.circuit.state  # noqa: E731
         degraded_probe = service.matcher.open_domains
+
+        def incremental_probe(_m=service.matcher):
+            t = _m._incremental_table
+            return t.gauge() if t is not None else None
+
+        # session-gap eviction drops the session's carried decode state
+        # with it — AFTER its final relaxed-threshold report flushed
+        def on_evict(uuid, _m=service.matcher):
+            t = _m._incremental_table
+            if t is not None:
+                t.evict(uuid, "session gap")
+
+        # snapshot v3 provider: restore must BUILD the table (frames in
+        # the snapshot need somewhere to live); save uses the property
+        # too — constructing the empty table is dict bookkeeping only
+        incremental_provider = lambda: service.matcher.incremental_table  # noqa: E731
 
     state = None
     if args.state_file:
         from .state import StateStore
-        state = StateStore(args.state_file, interval_s=args.state_interval)
+        state = StateStore(args.state_file, interval_s=args.state_interval,
+                           incremental=incremental_provider)
 
     tee = None
     datastore = None
@@ -600,6 +636,7 @@ def main(argv=None):
         uuid_filter=uuid_filter, submit_many=submit_many,
         report_flush_interval_s=args.report_flush_interval,
         circuit_probe=circuit_probe, degraded_probe=degraded_probe,
+        incremental_probe=incremental_probe, on_evict=on_evict,
         datastore=datastore, compactor=compactor)
     if not args.reporter_url:
         # poisoned-trace quarantine lands in THIS worker's trace spool

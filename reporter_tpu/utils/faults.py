@@ -75,7 +75,7 @@ KNOWN_SITES = frozenset({
     "matcher.submit", "egress.http", "datastore.commit",
     "datastore.compact", "datastore.lease", "state.save",
     "worker.offer", "worker.post_egress", "wire.native",
-    "admission.gate", "route.device",
+    "admission.gate", "route.device", "match.incremental.commit",
 })
 
 #: sites that place an ``after=True`` hook (the only position where

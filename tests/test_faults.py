@@ -947,7 +947,7 @@ class TestHealthDegradedBlock:
         assert body["degraded"]["open"] == []
         assert set(body["degraded"]["domains"]) == {
             "native.prep", "decode.dispatch", "matcher.assemble",
-            "route.device"}
+            "route.device", "match.incremental"}
         assert set(body["deadletter"]) == {"tiles", "traces"}
         for _ in range(m.circuit_decode.threshold):
             m.circuit_decode.record_failure()

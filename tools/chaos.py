@@ -17,6 +17,11 @@ Scenarios (run the named ones, default ``storm kill_restore``):
                 exact mid-stream offer -> restart -> snapshot restore ->
                 tile output byte-identical to a fault-free run (no lost
                 reports beyond the snapshot window, no duplicate tiles)
+  stream_resume incremental matcher (ISSUE 19): a commit-site error ->
+                batch-path fallback with byte-identical tiles; then a
+                mid-stream SIGKILL -> snapshot v3 restores the carried
+                per-trace decode state -> resumed run's final tiles
+                byte-identical to fault-free
   submit_burst  matcher 5xx burst -> bounded requeue under the retry
                 budget -> recovery without loss; a dead matcher ->
                 trace-JSON dead-letter spool instead of silent drops
@@ -305,6 +310,150 @@ def scenario_kill_restore() -> int:
                         f"extra={only_got[:5]} differ={differ[:5]}")
         log(f"kill_restore ok: {len(ref)} tile files byte-identical "
             f"across crash+restore")
+        return 0
+
+
+# ---------------------------------------------------------------------------
+def scenario_stream_resume() -> int:
+    """Incremental matcher crash-resume (ISSUE 19): two legs over the
+    ``match.incremental.commit`` fault site.
+
+    Leg A arms an *error* on a fixed-lag commit: the advance aborts, the
+    carried states drop, and the trace serves through the windowed batch
+    path — tiles byte-identical to a fault-free run (fallback, never
+    approximation). Leg B SIGKILLs the worker mid-stream AFTER several
+    incremental reports, so the last state snapshot (v3) carries live
+    per-trace decode state; the restarted worker must restore those
+    frames, resume the incremental decode mid-stream, and still produce
+    byte-identical final tiles."""
+    from reporter_tpu.utils import faults as faults_mod
+
+    with tempfile.TemporaryDirectory() as tmp:
+        city = _city()
+        graph = os.path.join(tmp, "city.npz")
+        city.save(graph)
+        lines = _lines(city, n_traces=6)
+        k = len(lines) * 2 // 3  # past several incremental flushes
+        full = os.path.join(tmp, "full.txt")
+        tail = os.path.join(tmp, "tail.txt")
+        with open(full, "w") as f:
+            f.write("\n".join(lines) + "\n")
+        with open(tail, "w") as f:
+            f.write("\n".join(lines[k:]) + "\n")
+
+        def cmd(inp, out, state):
+            return [sys.executable, "-m", "reporter_tpu", "stream",
+                    "-f", FMT, "--graph", graph, "-p", "1", "-q", "3600",
+                    "-i", "1000000000", "-s", "chaos", "-o", out,
+                    "--input", inp, "--state-file", state,
+                    "--state-interval", "0", "--uuid-filter", "off",
+                    "-r", "0,1,2", "-x", "0,1,2",
+                    # flush report-ready sessions immediately: mid-stream
+                    # reports are what build + snapshot carried state
+                    "--report-flush-interval", "0"]
+
+        # a tightened lag bound makes fixed-lag commits fire well inside
+        # the synthetic windows (so the armed commit site is hot) while
+        # still converging — at 4 the noise outlives the lag and every
+        # trace falls back to the batch path, leaving no carried state
+        # for leg B's snapshot to prove anything with
+        env = dict(os.environ, REPORTER_TPU_PLATFORM="cpu",
+                   REPORTER_TPU_INCREMENTAL_LAG="16")
+        env.pop("REPORTER_TPU_FAULTS", None)
+
+        out_ref = os.path.join(tmp, "ref")
+        log(f"stream_resume: fault-free run over {len(lines)} probes")
+        p = subprocess.run(cmd(full, out_ref, os.path.join(tmp, "s_ref")),
+                           env=env, cwd=REPO, capture_output=True,
+                           text=True, timeout=600)
+        if p.returncode != 0:
+            return fail(f"fault-free run rc={p.returncode}: "
+                        f"{p.stderr[-2000:]}")
+        ref = _tile_tree(out_ref)
+        if not ref:
+            return fail("fault-free run wrote no tiles")
+
+        # -- leg A: commit error -> batch-path fallback, same bytes ----
+        out_err = os.path.join(tmp, "err")
+        env_err = dict(env,
+                       REPORTER_TPU_FAULTS="match.incremental.commit="
+                                           "error#1")
+        log("stream_resume: leg A — error on a fixed-lag commit")
+        p = subprocess.run(cmd(full, out_err, os.path.join(tmp, "s_err")),
+                           env=env_err, cwd=REPO, capture_output=True,
+                           text=True, timeout=600)
+        if p.returncode != 0:
+            return fail(f"commit-error run rc={p.returncode}: "
+                        f"{p.stderr[-2000:]}")
+        if "incremental match failed" not in p.stderr:
+            return fail("commit fault never fired (the leg proved "
+                        "nothing): no fallback warning in stderr")
+        got = _tile_tree(out_err)
+        if got != ref:
+            differ = sorted(x for x in set(ref) & set(got)
+                            if ref[x] != got[x])
+            return fail(f"commit-error tiles diverge from fault-free: "
+                        f"missing={sorted(set(ref) - set(got))[:5]} "
+                        f"differ={differ[:5]}")
+        log(f"stream_resume: leg A ok — {len(ref)} tile files "
+            f"byte-identical under a commit fault")
+
+        # -- leg B: SIGKILL mid-stream, restore snapshot v3, resume ----
+        out_chaos = os.path.join(tmp, "chaos")
+        state = os.path.join(tmp, "s_chaos")
+        env_crash = dict(env,
+                         REPORTER_TPU_FAULTS=f"worker.offer=crash+{k}#1")
+        log(f"stream_resume: leg B — crashing at offer {k + 1}")
+        p = subprocess.run(cmd(full, out_chaos, state), env=env_crash,
+                           cwd=REPO, capture_output=True, text=True,
+                           timeout=600)
+        if p.returncode != faults_mod.CRASH_EXIT_CODE:
+            return fail(f"crash run rc={p.returncode} "
+                        f"(want {faults_mod.CRASH_EXIT_CODE}): "
+                        f"{p.stderr[-2000:]}")
+        if not os.path.exists(state):
+            return fail("no state snapshot survived the crash")
+        # the snapshot must actually CARRY carried state — an empty v3
+        # section would make the restore leg vacuously pass
+        from reporter_tpu.streaming import state as state_mod
+        from reporter_tpu.streaming.anonymiser import Anonymiser
+        from reporter_tpu.streaming.batcher import PointBatcher
+
+        class _Null:
+            def write(self, *a, **kw):
+                return None
+        with open(state, "rb") as f:
+            frames = state_mod.restore_bytes(
+                f.read(), PointBatcher(lambda t: None, lambda a, b: None),
+                Anonymiser(_Null(), 1, 3600))
+        if not frames:
+            return fail("crash snapshot carries no incremental decode "
+                        "state (v3 section empty)")
+        log(f"stream_resume: snapshot carries {len(frames)} carried "
+            f"decode state(s)")
+
+        log("stream_resume: restarting from the snapshot")
+        p = subprocess.run(cmd(tail, out_chaos, state), env=env, cwd=REPO,
+                           capture_output=True, text=True, timeout=600)
+        if p.returncode != 0:
+            return fail(f"restore run rc={p.returncode}: "
+                        f"{p.stderr[-2000:]}")
+        if "Restored state" not in p.stderr:
+            return fail("restore run did not restore the snapshot")
+        if "carried incremental decode state" not in p.stderr:
+            return fail("restore run did not restore the carried "
+                        "incremental decode states")
+
+        got = _tile_tree(out_chaos)
+        if got != ref:
+            only_ref = sorted(set(ref) - set(got))
+            only_got = sorted(set(got) - set(ref))
+            differ = sorted(x for x in set(ref) & set(got)
+                            if ref[x] != got[x])
+            return fail(f"tile trees diverge: missing={only_ref[:5]} "
+                        f"extra={only_got[:5]} differ={differ[:5]}")
+        log(f"stream_resume ok: {len(ref)} tile files byte-identical "
+            f"across commit fault AND crash+resume")
         return 0
 
 
@@ -1366,6 +1515,7 @@ def scenario_overload_recovery() -> int:
 SCENARIOS = {
     "storm": scenario_storm,
     "kill_restore": scenario_kill_restore,
+    "stream_resume": scenario_stream_resume,
     "prefork_kill": scenario_prefork_kill,
     "submit_burst": scenario_submit_burst,
     "egress_outage": scenario_egress_outage,

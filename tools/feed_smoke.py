@@ -10,6 +10,10 @@ Drives the REAL stack end-to-end, in-process:
      (condition-notified delivery, not sleep-polling)
   -> ``/histogram?window=5m`` serves the same rows immediately
   -> ``window=inf`` stays byte-identical to the windowless answer
+  -> a streamed point served by the INCREMENTAL matcher (carried
+     decode state, ISSUE 19) reports, tees, and reaches an open
+     ``/feed`` long-poll under the same deadline — the
+     probe -> live-dashboard loop with no whole-window re-decode
 
 A regression anywhere on the ingest -> overlay -> feed -> HTTP path
 fails CI here, with the service surface (not just library calls) on
@@ -135,13 +139,92 @@ def main(argv=None) -> int:
                 timeout=30).read()
             if plain != merged:
                 return fail("window=inf diverged from windowless bytes")
+
+            # 5) ISSUE 19 end-to-end: a streamed point served by the
+            # CARRIED-STATE matcher lands on /feed under the same
+            # deadline — probe -> incremental report -> worker-tee
+            # ingest -> overlay delta, no whole-window re-decode in the
+            # loop. The counter check keeps the leg honest: if the
+            # incremental path declined and the batch path quietly
+            # served, this smoke must fail, not pass vacuously.
+            import numpy as np
+
+            from reporter_tpu.streaming.batcher import \
+                segments_from_response
+            from reporter_tpu.synth import generate_trace
+            from reporter_tpu.utils import metrics
+
+            rng = np.random.default_rng(3)
+            tr = None
+            for _ in range(500):
+                tr = generate_trace(city, "inc-smoke", rng, noise_m=4.0)
+                if tr is not None:
+                    break
+            if tr is None:
+                return fail("could not generate a smoke trace")
+            pts = list(tr.points)
+            opts = {"report_levels": [0, 1, 2],
+                    "transition_levels": [0, 1, 2]}
+            m0 = metrics.counter("match.incremental.matches")
+            # first window builds the carried state; the second appends
+            # one point and advances it (the steady streaming shape)
+            service.report_incremental(
+                [{"uuid": "inc-smoke", "trace": pts[:-1],
+                  "match_options": opts}])
+            resp = service.report_incremental(
+                [{"uuid": "inc-smoke", "trace": pts,
+                  "match_options": opts}])[0]
+            if metrics.counter("match.incremental.matches") < m0 + 2:
+                return fail("the incremental path served neither "
+                            "window — the streamed-point leg is "
+                            "vacuous (batch fallback hid it)")
+            rows = [seg for _k, seg in segments_from_response(resp)]
+            if not rows:
+                return fail("incremental report produced no datastore "
+                            "rows")
+
+            cursor2 = got["body"]["cursor"]
+            got2 = {}
+
+            def subscribe2():
+                req = f"{url}/feed?cursor={cursor2}&timeout=30"
+                with urllib.request.urlopen(req, timeout=60) as r:
+                    got2["body"] = json.loads(r.read())
+                got2["t"] = time.monotonic()
+
+            th2 = threading.Thread(target=subscribe2)
+            th2.start()
+            waited = time.monotonic() + 10
+            while tier.feed.snapshot()["waiters"] == 0:
+                if time.monotonic() > waited:
+                    return fail("incremental-leg subscriber never "
+                                "registered as waiter")
+                time.sleep(0.005)
+            t_ingest2 = time.monotonic()
+            # the worker tee: reported rows ingest into the store
+            ds.ingest_segments(rows, ingest_key="smoke-incremental")
+            th2.join(timeout=args.deadline + 30)
+            if th2.is_alive():
+                return fail("subscriber still blocked after the "
+                            "incremental report's ingest")
+            latency2 = got2["t"] - t_ingest2
+            if latency2 > args.deadline:
+                return fail(f"incremental report's delta took "
+                            f"{latency2:.3f}s (deadline "
+                            f"{args.deadline}s)")
+            ev2 = got2["body"]["events"]
+            if not ev2 or ev2[0]["kind"] != "delta" \
+                    or rows[0].id not in ev2[0]["segments"]:
+                return fail(f"wrong incremental event: {got2['body']}")
         finally:
             httpd.shutdown()
 
         print(f"feed smoke ok: seed delivered at cursor {cursor}, "
               f"live delta in {latency * 1000:.1f} ms "
               f"(deadline {args.deadline}s), window=5m count=8, "
-              "inf==windowless bytes")
+              "inf==windowless bytes, incremental streamed point on "
+              f"/feed in {latency2 * 1000:.1f} ms "
+              f"({len(rows)} row(s))")
         return 0
 
 

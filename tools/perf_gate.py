@@ -37,6 +37,10 @@ Usage:
     # over a tools/feed_fanout_bench.py artifact (ISSUE 18)
     python tools/perf_gate.py --feed BENCH_FEED_r01.json
 
+    # incremental matcher: per-appended-point decode flatness + zero
+    # parity mismatches over a tools/stream_bench.py artifact (ISSUE 19)
+    python tools/perf_gate.py --streaming BENCH_STREAM_r01.json
+
 Exit 0 prints the verdict JSON with ``"pass": true``; any regression
 prints the offending comparison and exits 1. An empty comparable pool
 passes with a note (bootstrap-friendly) unless ``--require-history``.
@@ -423,6 +427,63 @@ def gate_feed(path: str, min_fanout: float) -> Tuple[bool, dict]:
     return (not verdict["failures"]), verdict
 
 
+def gate_streaming(path: str, max_ratio: float) -> Tuple[bool, dict]:
+    """Gate a tools/stream_bench.py artifact: the incremental matcher's
+    flat-decode contract (ISSUE 19). Per-appended-point decode p99 at
+    the longest window over the shortest (``flatness_ratio``) must stay
+    within ``max_ratio`` — a carried-state advance whose cost grows
+    with the window length is a whole-window re-decode wearing a cache
+    — parity mismatches against the batch oracle must be ZERO, and
+    every leg must have actually served incrementally (flatness over an
+    all-fallback leg is vacuous). A missing field fails loudly."""
+    with open(path, encoding="utf-8") as f:
+        art = json.load(f)
+    if art.get("kind") != "streaming":
+        raise SystemExit(f"{path} is not a streaming artifact")
+    verdict = {
+        "candidate": {"source": os.path.basename(path),
+                      "kind": "streaming",
+                      "lag": art.get("lag"),
+                      "windows": sorted(int(t) for t in
+                                        (art.get("legs") or {}))},
+        "flatness_ratio": art.get("flatness_ratio"),
+        "max_stream_ratio": max_ratio,
+        "failures": [],
+    }
+    legs = art.get("legs") or {}
+    missing = [k for k in ("flatness_ratio", "parity_mismatches")
+               if art.get(k) is None]
+    if not legs or len(legs) < 2:
+        missing.append("legs")
+    if missing:
+        verdict["failures"].append(
+            {"check": "streaming", "reason": "artifact is missing "
+             f"{missing} — a quantity that was never measured cannot "
+             "pass a flatness gate"})
+        return False, verdict
+    if art["parity_mismatches"]:
+        verdict["failures"].append(
+            {"check": "streaming", "candidate": art["parity_mismatches"],
+             "floor": 0,
+             "reason": f"{art['parity_mismatches']} served window(s) "
+             "differed from the batch oracle — the byte-parity "
+             "contract is broken"})
+    for t, leg in sorted(legs.items(), key=lambda kv: int(kv[0])):
+        if not leg.get("served"):
+            verdict["failures"].append(
+                {"check": "streaming", "candidate": 0, "floor": 1,
+                 "reason": f"T={t} served no window incrementally — "
+                 "its decode timings gate nothing"})
+    if art["flatness_ratio"] > max_ratio:
+        verdict["failures"].append(
+            {"check": "streaming", "candidate": art["flatness_ratio"],
+             "ceiling": max_ratio,
+             "reason": f"flatness_ratio {art['flatness_ratio']} > "
+             f"{max_ratio}: per-appended-point decode cost grows with "
+             "the window length"})
+    return (not verdict["failures"]), verdict
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="perf_gate",
                                      description=__doc__.splitlines()[0])
@@ -444,6 +505,16 @@ def main(argv=None) -> int:
                         help="feed_fanout_bench artifact: gate the "
                         "zero-silent-loss accounting and fanout ratio "
                         "against --min-fanout-ratio")
+    parser.add_argument("--streaming",
+                        help="stream_bench artifact: gate per-appended-"
+                        "point decode flatness against "
+                        "--max-stream-ratio and parity mismatches "
+                        "against zero")
+    parser.add_argument("--max-stream-ratio", type=float, default=1.5,
+                        help="ceiling for decode p99 at the longest "
+                        "window over the shortest in the --streaming "
+                        "gate (default 1.5; parity gates at zero "
+                        "regardless)")
     parser.add_argument("--min-fanout-ratio", type=float, default=0.95,
                         help="floor for delivered/subscribers in the "
                         "--feed gate (default 0.95; loss and errors "
@@ -521,6 +592,16 @@ def main(argv=None) -> int:
                 sys.stderr.write(f"perf_gate: FAIL: {f['reason']}\n")
         return 0 if passed else 1
 
+    if args.streaming:
+        passed, verdict = gate_streaming(args.streaming,
+                                         args.max_stream_ratio)
+        verdict["pass"] = passed
+        print(json.dumps(verdict, separators=(",", ":")))
+        if not passed:
+            for f in verdict["failures"]:
+                sys.stderr.write(f"perf_gate: FAIL: {f['reason']}\n")
+        return 0 if passed else 1
+
     if args.multichip:
         passed, verdict = gate_multichip(args.multichip,
                                          args.min_device_ratio)
@@ -556,8 +637,8 @@ def main(argv=None) -> int:
                                args.require_history)
     else:
         parser.error("need --candidate FILE, --self-check, "
-                     "--bigreplay FILE, --multichip FILE or "
-                     "--feed FILE")
+                     "--bigreplay FILE, --multichip FILE, "
+                     "--feed FILE or --streaming FILE")
         return 2  # unreachable; parser.error exits
 
     if max_shares:  # absolute ceilings, on top of the median gate
