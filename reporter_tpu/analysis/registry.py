@@ -96,6 +96,10 @@ ENV_KNOBS: Dict[str, str] = {
     "REPORTER_TPU_INCREMENTAL": "incremental matcher path (off disables)",
     "REPORTER_TPU_INCREMENTAL_LAG": "fixed-lag commit bound, kept points",
     "REPORTER_TPU_INCREMENTAL_MB": "carried-state table byte budget (MB)",
+    "REPORTER_TPU_SWAP_SAMPLE": "swap shadow capture sampling fraction",
+    "REPORTER_TPU_SWAP_AGREEMENT": "swap flip floor: min shadow agreement",
+    "REPORTER_TPU_SWAP_WINDOW": "swap capture-ring size (requests)",
+    "REPORTER_TPU_SWAP_FORCE": "override: flip below the agreement floor",
 }
 
 # ---- metric names ----------------------------------------------------------
@@ -191,6 +195,12 @@ METRICS: Dict[str, str] = {
     "datastore.lease.*": "writer-lease acquires/renewals/steals/rejections",
     "datastore.compactor.*": "background compaction passes/compactions",
     "datastore.city.*": "city-residency LRU loads/hits/evictions",
+    # map lifecycle (ISSUE 20: graph/version.py + cities.swap)
+    "swap.flips": "hot swaps that flipped routing to the new map",
+    "swap.refusals": "swaps refused (budget pin or shadow agreement)",
+    "swap.shadow.*": "dual-version gate: sampled/checks/agree/mismatch",
+    "datastore.epoch.*": "map-version epochs: stamped segments, pinned/"
+                         "merged queries, feed epoch events",
     "datastore.profile.exports": "route-memo profile artifacts written",
     "datastore.profile.warmed_pairs": "memo pairs pre-warmed at city load",
     # freshness tier (ISSUE 18: datastore/freshness.py + feed.py)
@@ -248,6 +258,10 @@ FAULT_SITES: Dict[str, str] = {
     "route.device": "device route fill error -> native re-prep with routes",
     "match.incremental.commit": "crash/error at a fixed-lag commit -> "
                                 "carried state dropped, batch-path replay",
+    "city.swap": "crash/error in the widest swap window (candidate "
+                 "loaded+gated, old still serving) -> old map keeps "
+                 "serving; crash recovery proves exactly-once across "
+                 "epochs",
 }
 
 # ---- durable layout roots --------------------------------------------------

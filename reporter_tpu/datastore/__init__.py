@@ -51,7 +51,7 @@ from .query import (
     query_segment,
 )
 from .schema import ObservationBatch
-from .store import HistogramStore
+from .store import EpochView, HistogramStore
 
 
 class LocalDatastore(HistogramStore):
@@ -90,57 +90,96 @@ class LocalDatastore(HistogramStore):
                                            budget_bytes=budget_bytes)
         return self.freshness
 
-    def _query_store(self, window):
+    def _query_store(self, window, map_version: Optional[str] = None,
+                     merge: bool = False):
         """The store the query layer should sweep for this request:
         ``window=None`` is ALWAYS ``self`` (the pre-freshness path,
         byte-identical by construction); a window resolves through the
         overlay. A process without the tier serves ``inf`` as the
         plain compacted store (the overlay would add nothing) and a
         finite window as empty (it has witnessed no recent ingests —
-        windows need the tee co-located, see README)."""
+        windows need the tee co-located, see README).
+
+        Epoch pin/merge semantics (graph versioning): the effective
+        pin is the explicit ``map_version=`` if given, else the store's
+        ACTIVE version (the latest map build this process serves) —
+        histograms never silently mix epochs. ``merge=True`` is the
+        explicit opt-in that sweeps every epoch (and is mutually
+        exclusive with an explicit pin); a store with no version
+        (every pre-versioning deployment) behaves exactly as before."""
+        from ..utils import metrics
+        if merge and map_version is not None:
+            raise ValueError(
+                "merge and map_version are mutually exclusive")
+        pin = None
+        if not merge:
+            pin = map_version if map_version is not None \
+                else self.map_version
+        if pin is not None:
+            metrics.count("datastore.epoch.pinned_queries")
+        elif merge:
+            metrics.count("datastore.epoch.merged_queries")
         if window is None:
+            if pin is not None:
+                from .store import EpochView
+                return EpochView(self, pin)
             return self
         import math
         w = parse_window(window)
         if self.freshness is not None:
-            return self.freshness.query_view(w)
-        return self if math.isinf(w) else OverlayView({})
+            return self.freshness.query_view(w, map_version=pin)
+        if math.isinf(w):
+            if pin is not None:
+                from .store import EpochView
+                return EpochView(self, pin)
+            return self
+        return OverlayView({})
 
     def query(self, segment_id: int,
               hours: Optional[Sequence[int]] = None,
               percentiles: Sequence[float] = DEFAULT_PERCENTILES,
-              max_transitions: int = 32, window=None) -> dict:
-        return query_segment(self._query_store(window), segment_id,
-                             hours=hours, percentiles=percentiles,
-                             max_transitions=max_transitions)
+              max_transitions: int = 32, window=None,
+              map_version: Optional[str] = None,
+              merge: bool = False) -> dict:
+        return query_segment(
+            self._query_store(window, map_version, merge), segment_id,
+            hours=hours, percentiles=percentiles,
+            max_transitions=max_transitions)
 
     def query_many(self, segment_ids,
                    hours: Optional[Sequence[int]] = None,
                    percentiles: Sequence[float] = DEFAULT_PERCENTILES,
-                   max_transitions: int = 32, window=None) -> list:
+                   max_transitions: int = 32, window=None,
+                   map_version: Optional[str] = None,
+                   merge: bool = False) -> list:
         """Batched spelling of :meth:`query`: one sweep per partition's
         live segment files serves the whole id list (datastore/query.py)
         — answer-identical to N single queries by construction."""
-        return query_many(self._query_store(window), segment_ids,
-                          hours=hours, percentiles=percentiles,
-                          max_transitions=max_transitions)
+        return query_many(
+            self._query_store(window, map_version, merge), segment_ids,
+            hours=hours, percentiles=percentiles,
+            max_transitions=max_transitions)
 
     def query_bbox(self, bbox, level: int,
                    hours: Optional[Sequence[int]] = None,
                    percentiles: Sequence[float] = DEFAULT_PERCENTILES,
                    max_transitions: int = 32,
                    max_segments: Optional[int] = None,
-                   window=None) -> dict:
+                   window=None,
+                   map_version: Optional[str] = None,
+                   merge: bool = False) -> dict:
         kwargs = {}
         if max_segments is not None:
             kwargs["max_segments"] = max_segments
-        return query_bbox(self._query_store(window), bbox, level,
-                          hours=hours, percentiles=percentiles,
-                          max_transitions=max_transitions, **kwargs)
+        return query_bbox(
+            self._query_store(window, map_version, merge), bbox, level,
+            hours=hours, percentiles=percentiles,
+            max_transitions=max_transitions, **kwargs)
 
 
 __all__ = [
-    "BackgroundCompactor", "ChangeFeed", "Delta", "FeedOverload",
+    "BackgroundCompactor", "ChangeFeed", "Delta", "EpochView",
+    "FeedOverload",
     "FreshnessTier", "HistogramStore", "LeaseHeldElsewhere",
     "LocalDatastore", "ObservationBatch", "OverlayView",
     "RecentDeltaOverlay", "freshness_enabled", "parse_window",

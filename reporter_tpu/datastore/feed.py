@@ -90,11 +90,11 @@ class FeedEvent:
     subscriber sweeps the tile)."""
 
     __slots__ = ("seq", "kind", "level", "index", "segments",
-                 "truncated", "rows", "arrival")
+                 "truncated", "rows", "arrival", "map_version")
 
     def __init__(self, seq: int, kind: str, level: int, index: int,
                  segments: List[int], truncated: bool, rows: int,
-                 arrival: float):
+                 arrival: float, map_version: Optional[str] = None):
         self.seq = seq
         self.kind = kind
         self.level = level
@@ -103,6 +103,11 @@ class FeedEvent:
         self.truncated = truncated
         self.rows = rows
         self.arrival = arrival
+        # graph epoch of the producing ingest (graph/version.py); an
+        # ``epoch`` event announces a hot swap and carries the NEW
+        # version — a cursor held across the swap sees the boundary
+        # explicitly instead of merging deltas from two maps
+        self.map_version = map_version
 
     def to_wire(self) -> dict:
         out = {"seq": self.seq, "kind": self.kind, "level": self.level,
@@ -110,6 +115,8 @@ class FeedEvent:
                "rows": self.rows, "arrival": round(self.arrival, 3)}
         if self.truncated:
             out["truncated"] = True
+        if self.map_version is not None:
+            out["map_version"] = self.map_version
         return out
 
 
@@ -146,15 +153,29 @@ class ChangeFeed:
         truncated = segs.shape[0] > EVENT_SEGMENTS_CAP
         self._publish("delta", entry.level, entry.index,
                       segs[:EVENT_SEGMENTS_CAP].tolist(), truncated,
-                      int(entry.delta.rows))
+                      int(entry.delta.rows),
+                      map_version=getattr(entry, "map_version", None))
+
+    def publish_epoch(self, map_version: str) -> None:
+        """Announce a graph epoch boundary (a city hot swap flipped):
+        one ``epoch`` event carrying the NEW map_version. Delivered to
+        EVERY subscriber regardless of bbox/level filter — the
+        resync-style contract: whatever viewport a dashboard watches,
+        its history predates the new map, so it must re-query once and
+        drop cross-epoch merges."""
+        metrics.count("datastore.epoch.events")
+        self._publish("epoch", -1, -1, [], False, 0,
+                      map_version=str(map_version))
 
     def _publish(self, kind: str, level: int, index: int,
-                 segments: List[int], truncated: bool, rows: int) -> None:
+                 segments: List[int], truncated: bool, rows: int,
+                 map_version: Optional[str] = None) -> None:
         with self._cond:
             self._seq += 1
             self._ring.append(FeedEvent(self._seq, kind, int(level),
                                         int(index), segments, truncated,
-                                        rows, self.clock()))
+                                        rows, self.clock(),
+                                        map_version=map_version))
             metrics.count("feed.events")
             self._cond.notify_all()
 
@@ -213,6 +234,13 @@ class ChangeFeed:
         out: List[FeedEvent] = []
         for ev in self._ring:
             if ev.seq <= cursor:
+                continue
+            if ev.kind == "epoch":
+                # epoch boundaries bypass viewport filters: every
+                # subscriber's held history predates the new map
+                out.append(ev)
+                if len(out) >= max_events:
+                    break
                 continue
             if level is not None and ev.level != level:
                 continue

@@ -117,11 +117,11 @@ class OverlayEntry:
     add on top of the compacted store."""
 
     __slots__ = ("seq", "ingest_key", "level", "index", "delta",
-                 "arrival", "in_store", "nbytes")
+                 "arrival", "in_store", "map_version", "nbytes")
 
     def __init__(self, seq: int, ingest_key: Optional[str], level: int,
                  index: int, delta: Delta, arrival: float,
-                 in_store: bool):
+                 in_store: bool, map_version: Optional[str] = None):
         self.seq = seq
         self.ingest_key = ingest_key
         self.level = level
@@ -129,6 +129,11 @@ class OverlayEntry:
         self.delta = delta
         self.arrival = arrival
         self.in_store = in_store
+        # graph epoch of the producing store (graph/version.py): rides
+        # into every /feed event, and a version-pinned window query
+        # filters on it — a dashboard spanning a hot swap must not
+        # merge deltas from two maps
+        self.map_version = map_version
         self.nbytes = _ENTRY_OVERHEAD_BYTES + sum(
             np.asarray(getattr(delta, col)).nbytes
             for col in ("hist_key", "hist_count", "hist_speed_sum",
@@ -165,7 +170,9 @@ class RecentDeltaOverlay:
 
     def record(self, level: int, index: int, delta: Delta,
                ingest_key: Optional[str],
-               in_store: bool = True) -> Optional[OverlayEntry]:
+               in_store: bool = True,
+               map_version: Optional[str] = None
+               ) -> Optional[OverlayEntry]:
         """Record one ingested partition delta; None when the key was
         already recorded (the dedupe no-op — a True ``in_store`` still
         upgrades the existing entry, so a spooled-then-replayed flush
@@ -186,7 +193,8 @@ class RecentDeltaOverlay:
                 key = ("_anon", self._seq + 1, int(level), int(index))
             self._seq += 1
             entry = OverlayEntry(self._seq, ingest_key, int(level),
-                                 int(index), delta, arrival, in_store)
+                                 int(index), delta, arrival, in_store,
+                                 map_version=map_version)
             self._entries[key] = entry
             self._bytes += entry.nbytes
             metrics.count("overlay.records")
@@ -198,19 +206,27 @@ class RecentDeltaOverlay:
             return entry
 
     def window_deltas(self, window_s: float,
-                      now: Optional[float] = None
+                      now: Optional[float] = None,
+                      map_version: Optional[str] = None
                       ) -> Dict[Tuple[int, int], List[Delta]]:
         """Per-partition deltas that arrived within ``window_s`` of now
-        — the finite-window view's entire contents."""
+        — the finite-window view's entire contents. A ``map_version``
+        pin drops entries stamped with a DIFFERENT epoch (untagged
+        legacy entries pass, matching EpochView's on-disk rule)."""
         horizon = (now if now is not None else self.clock()) - window_s
         out: Dict[Tuple[int, int], List[Delta]] = {}
         with self._lock:
             for e in self._entries.values():
+                if map_version is not None \
+                        and e.map_version is not None \
+                        and e.map_version != map_version:
+                    continue
                 if e.arrival >= horizon:
                     out.setdefault((e.level, e.index), []).append(e.delta)
         return out
 
-    def uncommitted_deltas(self, store
+    def uncommitted_deltas(self, store,
+                           map_version: Optional[str] = None
                            ) -> Dict[Tuple[int, int], List[Delta]]:
         """Per-partition deltas the compacted store does NOT carry —
         the only thing ``window=∞`` adds on top of it. Each candidate
@@ -225,6 +241,9 @@ class RecentDeltaOverlay:
         out: Dict[Tuple[int, int], List[Delta]] = {}
         ledgers: Dict[str, dict] = {}
         for e in pending:
+            if map_version is not None and e.map_version is not None \
+                    and e.map_version != map_version:
+                continue
             pdir = store.partition_dir(e.level, e.index)
             if pdir not in ledgers:
                 ledgers[pdir] = store._read_manifest(pdir).get(
@@ -408,12 +427,14 @@ class FreshnessTier:
         self.viewports = ViewportSummaries(store)
 
     def record(self, level: int, index: int, delta: Delta,
-               ingest_key: Optional[str], in_store: bool = True) -> None:
+               ingest_key: Optional[str], in_store: bool = True,
+               map_version: Optional[str] = None) -> None:
         """Ingest-path hook (store.py): record + publish. Never raises
         — a freshness failure must not fail the durable ingest."""
         try:
             entry = self.overlay.record(level, index, delta, ingest_key,
-                                        in_store=in_store)
+                                        in_store=in_store,
+                                        map_version=map_version)
             if entry is not None:
                 self.feed.publish_delta(entry)
         except Exception as e:
@@ -421,15 +442,24 @@ class FreshnessTier:
             logger.error("freshness record failed for %d/%d: %s",
                          level, index, e)
 
-    def query_view(self, window_s: float):
+    def query_view(self, window_s: float,
+                   map_version: Optional[str] = None):
         """The store-protocol view a ``window=`` query sweeps: finite →
         overlay-only entries inside the window; ``inf`` → compacted
-        store + overlay entries the store does not carry."""
+        store + overlay entries the store does not carry. A
+        ``map_version`` pin filters both layers to one graph epoch."""
         metrics.count("overlay.window_queries")
         if math.isinf(window_s):
-            return OverlayView(self.overlay.uncommitted_deltas(self.store),
-                               base=self.store)
-        return OverlayView(self.overlay.window_deltas(window_s))
+            base = self.store
+            if map_version is not None:
+                from .store import EpochView
+                base = EpochView(self.store, map_version)
+            return OverlayView(
+                self.overlay.uncommitted_deltas(
+                    self.store, map_version=map_version),
+                base=base)
+        return OverlayView(self.overlay.window_deltas(
+            window_s, map_version=map_version))
 
     def on_compactor_pass(self) -> None:
         """The background compactor's paced hook: refresh viewport

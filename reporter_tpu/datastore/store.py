@@ -135,6 +135,31 @@ class HistogramStore:
         # per-partition delta here so window=/feed surfaces see a
         # flush the instant it lands, whatever producer drove it
         self.freshness = None
+        # active graph epoch (graph/version.py): owners serving a
+        # versioned graph set this (CityRegistry loads, the swap chaos
+        # harness); when set, commits stamp it into the manifest +
+        # per-segment epoch map and the ingest-ledger key so histograms
+        # can never silently mix observations from two map builds.
+        # None (the default, every pre-versioning producer) keeps the
+        # layout and ledger keys byte-identical to before
+        self.map_version: Optional[str] = None
+
+    def set_map_version(self, version: Optional[str]) -> None:
+        self.map_version = str(version) if version else None
+
+    def _epoch_key(self, ingest_key: Optional[str]) -> Optional[str]:
+        """The effective exactly-once ledger key: the flush identity,
+        epoch-qualified when this store serves a versioned graph. The
+        same tile re-offered under a NEW map build is new data (its
+        segments were matched against different geometry), so it must
+        not dedupe against the old epoch's commit."""
+        if ingest_key is None or self.map_version is None:
+            return ingest_key
+        suffix = f"@{self.map_version}"
+        # idempotent: ingest() qualifies before the freshness hook and
+        # append() qualifies again — both must agree on one spelling
+        return ingest_key if ingest_key.endswith(suffix) \
+            else ingest_key + suffix
 
     # -- paths -------------------------------------------------------------
     def partition_dir(self, level: int, index: int) -> str:
@@ -205,6 +230,10 @@ class HistogramStore:
         # the tile body for replay, it never risks a manifest commit
         # interleaved with the live holder's
         self.lease.require()
+        # epoch-qualify the ledger key up front so every use below —
+        # pre-check, authoritative re-check, ledger insert — sees one
+        # spelling (a None map_version leaves the key untouched)
+        ingest_key = self._epoch_key(ingest_key)
         with metrics.timer("datastore.store.append"):
             pdir = self.partition_dir(level, index)
             os.makedirs(pdir, exist_ok=True)
@@ -243,6 +272,16 @@ class HistogramStore:
                 self._check_seq_fence(pdir, seq - 1)
                 manifest["seq"] = seq
                 manifest["segments"] = manifest["segments"] + [name]
+                if self.map_version is not None:
+                    # epoch stamp: the manifest's map_version is the
+                    # active epoch, ``epochs`` tags each segment with
+                    # the build that produced it — queries pin on it
+                    # (EpochView) and compaction never merges across it
+                    manifest["map_version"] = self.map_version
+                    epochs = dict(manifest.get("epochs", {}))
+                    epochs[name] = self.map_version
+                    manifest["epochs"] = epochs
+                    metrics.count("datastore.epoch.stamped_segments")
                 if ingest_key is not None:
                     ingested = dict(manifest.get("ingested", {}))
                     ingested[ingest_key] = name
@@ -371,18 +410,23 @@ class HistogramStore:
         # tile is being spooled, and window=∞ must serve those rows
         # from the overlay until the dead-letter replay lands)
         fresh = self.freshness
+        # the overlay dedupes (and uncommitted_deltas re-checks the
+        # ledger) on the SAME epoch-qualified key append commits under
+        ekey = self._epoch_key(ingest_key)
         for (level, index), delta in aggregate(obs).items():
             try:
                 name = self.append(level, index, delta,
                                    ingest_key=ingest_key)
             except Exception:
                 if fresh is not None:
-                    fresh.record(level, index, delta, ingest_key,
-                                 in_store=False)
+                    fresh.record(level, index, delta, ekey,
+                                 in_store=False,
+                                 map_version=self.map_version)
                 raise
             if fresh is not None:
-                fresh.record(level, index, delta, ingest_key,
-                             in_store=True)
+                fresh.record(level, index, delta, ekey,
+                             in_store=True,
+                             map_version=self.map_version)
             if name is None:
                 continue
             rows += delta.rows
@@ -551,14 +595,35 @@ class HistogramStore:
             names = manifest["segments"]
             if len(names) <= 1:
                 return 0
-            deltas = [d for d in (self.load_segment(pdir, n) for n in names)
-                      if d is not None]
-            seq = manifest["seq"] + 1
-            base = f"base-{seq:06d}"
-            # staged under the lock, unlike append: the merge input is
-            # the live segment list, which must not move underneath it
-            tmp = self._stage_segment(pdir, merge_deltas(deltas))
-            self._commit_segment(pdir, tmp, base)
+            # compaction is epoch-aware: segments group by the map
+            # build that produced them (untagged legacy segments form
+            # their own group) and each group merges into its OWN base
+            # — merging across epochs would manufacture exactly the
+            # mixed-version histogram cells the epoch stamps exist to
+            # prevent. The common single-epoch partition still ends in
+            # one base, byte-identical to the pre-epoch behaviour.
+            tags = manifest.get("epochs", {})
+            groups: "OrderedDict[Optional[str], List[str]]" = OrderedDict()
+            for n in names:
+                groups.setdefault(tags.get(n), []).append(n)
+            seq0 = manifest["seq"]
+            bumps = 0
+            new_segments: List[str] = []
+            new_epochs: Dict[str, str] = {}
+            for tag, group in groups.items():
+                deltas = [d for d
+                          in (self.load_segment(pdir, n) for n in group)
+                          if d is not None]
+                bumps += 1
+                base = f"base-{seq0 + bumps:06d}"
+                # staged under the lock, unlike append: the merge input
+                # is the live segment list, which must not move
+                # underneath it
+                tmp = self._stage_segment(pdir, merge_deltas(deltas))
+                self._commit_segment(pdir, tmp, base)
+                new_segments.append(base)
+                if tag is not None:
+                    new_epochs[base] = tag
             # chaos hook (lease_kill): a crash HERE dies HOLDING the
             # lease mid-compaction, in the widest window — the merged
             # base- dir is renamed in place but the manifest still
@@ -566,11 +631,15 @@ class HistogramStore:
             # orphan dir is invisible), and the next process must steal
             # the dead holder's lease and re-compact to an untorn state
             faults.failpoint("datastore.compact")
-            self._check_seq_fence(pdir, seq - 1)
+            self._check_seq_fence(pdir, seq0)
             # the ingested ledger survives compaction: the merged base
             # still CONTAINS those flushes, so dropping their keys would
             # re-open the double-ingest window the ledger closes
-            compacted = {"seq": seq, "segments": [base]}
+            compacted = {"seq": seq0 + bumps, "segments": new_segments}
+            if new_epochs:
+                compacted["epochs"] = new_epochs
+            if manifest.get("map_version"):
+                compacted["map_version"] = manifest["map_version"]
             if manifest.get("ingested"):
                 compacted["ingested"] = manifest["ingested"]
             self._write_manifest(pdir, compacted)
@@ -587,7 +656,8 @@ class HistogramStore:
                     shutil.rmtree(os.path.join(pdir, leftover),
                                   ignore_errors=True)
             logger.info("compacted %d/%d: %d segments -> %s",
-                        level, index, len(names), base)
+                        level, index, len(names),
+                        ",".join(new_segments))
             return len(names)
 
     # -- introspection -----------------------------------------------------
@@ -637,4 +707,46 @@ class HistogramStore:
         return out
 
 
-__all__ = ["HistogramStore", "MANIFEST"]
+class EpochView:
+    """Store-protocol facade pinning reads to ONE map_version.
+
+    Satisfies the same three-method protocol the query layer sweeps
+    (``partitions`` / ``live_segments`` / ``resident_segments``, like
+    freshness.OverlayView), serving only segments whose manifest epoch
+    tag matches the pin. Untagged segments — everything committed
+    before the store carried a version — pass through, so enabling
+    versioning on an existing store never hides its history. Reads are
+    manifest-driven per call and bypass the handle LRU: a pinned query
+    is the rare post-swap audit path, not the dashboard hot path.
+    """
+
+    def __init__(self, store: HistogramStore, map_version: str):
+        self.store = store
+        self.map_version = str(map_version)
+
+    def partitions(self):
+        return self.store.partitions()
+
+    def live_segments(self, level: int, index: int) -> List[Delta]:
+        pdir = self.store.partition_dir(level, index)
+        manifest = self.store._read_manifest(pdir)
+        tags = manifest.get("epochs", {})
+        out = []
+        for name in manifest["segments"]:
+            tag = tags.get(name)
+            if tag is not None and tag != self.map_version:
+                continue
+            d = self.store.load_segment(pdir, name)
+            if d is not None:
+                out.append(d)
+        return out
+
+    def resident_segments(self, level: int, index: int) -> np.ndarray:
+        from .schema import CELLS_PER_SEGMENT
+        segs = [np.unique(np.asarray(p.hist_key) // CELLS_PER_SEGMENT)
+                for p in self.live_segments(level, index)]
+        return np.unique(np.concatenate(segs)) if segs \
+            else np.zeros(0, dtype=np.int64)
+
+
+__all__ = ["HistogramStore", "EpochView", "MANIFEST"]

@@ -1,9 +1,11 @@
 from .network import RoadNetwork, EdgeAttr
 from .spatial import SpatialGrid, CandidateSet
 from .route import route_distance, candidate_route_matrices
+from .version import map_version
 
 __all__ = [
     "RoadNetwork", "EdgeAttr",
     "SpatialGrid", "CandidateSet",
     "route_distance", "candidate_route_matrices",
+    "map_version",
 ]

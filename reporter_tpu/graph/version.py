@@ -1,0 +1,79 @@
+"""Content-derived graph versions: the identity of a map build.
+
+The reference fleet sits on a continuously refreshed OSMLR/OSM tile
+substrate; ours treated the loaded :class:`RoadNetwork` as immutable
+and anonymous. Every layer that outlives a graph — carried incremental
+decode state, histogram partitions, change-feed cursors — needs a way
+to say *which* map produced a value, or a hot swap silently mixes two
+road networks' segment ids.
+
+``map_version(net)`` hashes the persisted graph columns (the same
+arrays ``RoadNetwork.save`` writes — derived caches are excluded, so a
+reloaded graph hashes identically) into a short stable token. The
+optional ``extra`` bytes fold the committed ``.profile`` artifact in,
+so a re-profiled build is a *new* version even when the geometry is
+unchanged (the route memo it pre-warms is part of the serving
+contract). The token is cached on the network object: every call after
+the first is an attribute read, cheap enough for per-request paths.
+"""
+from __future__ import annotations
+
+import hashlib
+from typing import Optional
+
+import numpy as np
+
+# the persisted columns, in a fixed order (matching RoadNetwork.save);
+# hashing vars() would pick up lazily-built derived caches and make the
+# version depend on which queries ran first
+_HASHED_FIELDS = (
+    "node_lat", "node_lon",
+    "edge_start", "edge_end",
+    "edge_length_m", "edge_speed_kph",
+    "edge_segment_id", "edge_segment_offset_m",
+    "edge_internal",
+)
+
+#: hex digits kept: 12 (48 bits) — collision-safe for any realistic
+#: number of map builds while staying readable in /health and manifests
+VERSION_LEN = 12
+
+
+def map_version(net, extra: Optional[bytes] = None) -> str:
+    """The content-derived version token of ``net``.
+
+    Stable across save/load round trips and process restarts; cached on
+    the network object (``net._map_version``) after the first call.
+    ``extra`` (e.g. the raw bytes of the city's ``.profile`` artifact)
+    is folded in WITHOUT being cached — callers mixing in an artifact
+    get a fresh digest each call.
+    """
+    cached = getattr(net, "_map_version", None)
+    if cached is None:
+        h = hashlib.sha256()
+        for name in _HASHED_FIELDS:
+            col = getattr(net, name, None)
+            if col is None:
+                continue
+            arr = np.ascontiguousarray(col)
+            h.update(name.encode())
+            h.update(str(arr.dtype).encode())
+            h.update(arr.tobytes())
+        # segment_length_m is a dict; hash it in sorted-key order the
+        # way save() serialises it
+        seg = getattr(net, "segment_length_m", None) or {}
+        for sid in sorted(seg):
+            h.update(b"%d:%a" % (int(sid), float(seg[sid])))
+        cached = h.hexdigest()[:VERSION_LEN]
+        try:
+            net._map_version = cached
+        except Exception:
+            pass  # slotted / frozen stand-ins: just recompute next time
+    if extra:
+        h = hashlib.sha256(cached.encode())
+        h.update(extra)
+        return h.hexdigest()[:VERSION_LEN]
+    return cached
+
+
+__all__ = ["map_version", "VERSION_LEN"]

@@ -170,16 +170,22 @@ class _Step:
 class CarriedState:
     """Everything one uuid's decode carries between appended points."""
 
-    __slots__ = ("params_key", "f16", "K", "t0", "last_time", "n_raw",
+    __slots__ = ("params_key", "f16", "K", "map_version",
+                 "t0", "last_time", "n_raw",
                  "has_cands", "last_kept_raw", "last_lat", "last_lon",
                  "tail_ok", "prev_cand", "scores",
                  "c_kept", "c_case", "c_col", "c_edge", "c_off", "c_route",
                  "ring")
 
-    def __init__(self, params_key, f16: bool, K: int):
+    def __init__(self, params_key, f16: bool, K: int,
+                 map_version: Optional[str] = None):
         self.params_key = params_key
         self.f16 = bool(f16)
         self.K = int(K)
+        # the graph build this state's edge ids/backpointers belong to
+        # (graph/version.py); part of the cache identity — a hot swap
+        # must never serve segment ids decoded against a dead graph
+        self.map_version = map_version
         self.t0 = 0.0                 # first raw time of the window
         self.last_time = 0.0          # last processed raw time
         self.n_raw = 0                # raw points processed
@@ -219,7 +225,7 @@ class CarriedState:
         and the packed counts."""
         K = self.K
         key = np.asarray(self.params_key, dtype=np.float64)
-        out = [self._HEAD.pack(1, int(self.f16), K, self.t0,
+        out = [self._HEAD.pack(2, int(self.f16), K, self.t0,
                                self.last_time, self.n_raw,
                                self.last_kept_raw, len(self.c_kept),
                                self.tail_ok, self.prev_cand is not None,
@@ -249,6 +255,10 @@ class CarriedState:
             out += [r.edge_ids.tobytes(), r.offset_m.tobytes()]
             if not first:
                 out += [r.bp.tobytes(), r.route_in.tobytes()]
+        # v2 trailer: the graph version the state was decoded against
+        mv = (self.map_version or "").encode()
+        out.append(struct.pack("<H", len(mv)))
+        out.append(mv)
         return b"".join(out)
 
     @classmethod
@@ -266,7 +276,7 @@ class CarriedState:
         (ver, f16, K, t0, last_time, n_raw, last_kept, n_c, tail_ok,
          has_prev, last_lat, last_lon) = cls._HEAD.unpack(
             take(cls._HEAD.size))
-        if ver != 1:
+        if ver not in (1, 2):
             raise ValueError(f"carried-state version {ver} unsupported")
         n_key, n_ring = struct.unpack("<HH", take(4))
         key = tuple(np.frombuffer(take(8 * n_key), dtype=np.float64)
@@ -307,6 +317,14 @@ class CarriedState:
                                          ).reshape(K, K)
             st.ring.append(_Ring(kept_idx, case, edge, offm, bp,
                                  prev_best, route_in))
+        if ver >= 2:
+            (n_mv,) = struct.unpack("<H", take(2))
+            mv = take(n_mv).decode()
+            st.map_version = mv or None
+        # ver 1 blobs predate graph versioning: map_version stays None,
+        # which a versioned table treats as a mismatch — the trace
+        # re-decodes from its window rather than trusting edge ids of
+        # unknown provenance
         return st
 
 
@@ -330,6 +348,15 @@ class IncrementalTable:
 
     def __init__(self, matcher):
         self.matcher = matcher
+        # cache identity includes the graph build (graph/version.py):
+        # a city hot swap rebuilds the matcher around a new net, and
+        # every carried state minted against the old one must reset
+        # instead of serving segment ids from a dead graph
+        try:
+            from ..graph.version import map_version
+            self.map_version: Optional[str] = map_version(matcher.net)
+        except Exception:
+            self.map_version = None
         self._states: Dict[str, CarriedState] = {}
         self._order: List[str] = []   # LRU, oldest first
         self._lock = threading.Lock()
@@ -342,6 +369,7 @@ class IncrementalTable:
     def gauge(self) -> dict:
         with self._lock:
             return {"traces": len(self._states),
+                    "map_version": self.map_version,
                     "state_bytes": self._bytes,
                     "budget_bytes": budget_bytes(),
                     "lag": lag_bound(),
@@ -517,6 +545,7 @@ class IncrementalTable:
         st = self._states.get(uuid)
         if st is not None:
             ok = (st.params_key == key and st.f16 == f16
+                  and st.map_version == self.map_version
                   and 0 < st.n_raw <= n
                   and st.t0 == float(times[0])
                   and st.last_time == float(times[st.n_raw - 1]))
@@ -531,7 +560,8 @@ class IncrementalTable:
                 metrics.count("match.incremental.resets")
                 st = None
         if st is None:
-            st = CarriedState(key, f16, int(params.max_candidates))
+            st = CarriedState(key, f16, int(params.max_candidates),
+                              map_version=self.map_version)
             self._states[uuid] = st
             self._touch(uuid)
         return st

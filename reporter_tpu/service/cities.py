@@ -26,8 +26,10 @@ and /profile carry the residency table.
 """
 from __future__ import annotations
 
+import json
 import logging
-from collections import OrderedDict
+import os
+from collections import OrderedDict, deque
 from typing import Callable, Dict, Optional
 
 import numpy as np
@@ -42,6 +44,36 @@ def city_budget_bytes() -> int:
     from ..utils.runtime import _env_float
     return int(_env_float("REPORTER_TPU_CITY_BUDGET_MB", 512.0)
                * 1024 * 1024)
+
+
+def swap_sample_fraction() -> float:
+    """Fraction of admitted /report traffic sampled into a resident
+    city's capture ring — the dual-version shadow gate's corpus."""
+    from ..utils.runtime import _env_float
+    return max(0.0, min(1.0,
+                        _env_float("REPORTER_TPU_SWAP_SAMPLE", 0.25)))
+
+
+def swap_agreement_floor() -> float:
+    """Minimum segment-id agreement (old vs candidate graph over the
+    capture ring) below which :meth:`CityRegistry.swap` refuses to
+    flip."""
+    from ..utils.runtime import _env_float
+    return _env_float("REPORTER_TPU_SWAP_AGREEMENT", 0.99)
+
+
+def swap_window() -> int:
+    """Capture-ring capacity: how many sampled requests the shadow
+    gate re-scores at swap time."""
+    from ..utils.runtime import _env_int
+    return max(1, _env_int("REPORTER_TPU_SWAP_WINDOW", 64))
+
+
+def swap_force() -> bool:
+    """Operator override: flip even below the agreement floor (an
+    intentional map change legitimately rewrites segment ids)."""
+    from ..utils.runtime import _env_int
+    return bool(_env_int("REPORTER_TPU_SWAP_FORCE", 0))
 
 
 def _graph_bytes(net) -> int:
@@ -60,22 +92,65 @@ class CityEntry:
     """One resident city's wired stack."""
 
     def __init__(self, name: str, service, size_bytes: int,
-                 warmed_pairs: int = 0):
+                 warmed_pairs: int = 0,
+                 map_version: Optional[str] = None):
         self.name = name
         self.service = service
         self.size_bytes = size_bytes
         self.warmed_pairs = warmed_pairs
+        # content-derived graph identity (graph/version.py), stamped by
+        # the registry load; swap() compares it across versions and
+        # /health surfaces it per resident city
+        self.map_version = map_version
         # in-flight request pins (registry._reslock guards both): an
         # evicted entry with live pins defers its close to the last
         # release — eviction must never stop the dispatcher under a
         # request another handler thread is still serving through it
         self._refs = 0
         self._evicted = False
+        # swap shadow capture: a bounded ring of recently admitted
+        # /report requests (deterministic accumulator sampling, same
+        # family as the obs/profiler shadow sampler); swap() re-scores
+        # the ring on BOTH the serving and the candidate graph off the
+        # hot path — the dual-version shadow gate's evidence
+        self._capture: deque = deque(maxlen=swap_window())
+        self._cap_acc = 0.0
+        self._cap_lock = _locks.new_lock("datastore.cities.capture")
+
+    def observe(self, req: dict) -> None:
+        """Sample one admitted /report request into the capture ring
+        (hot-path cost: one accumulator add; the occasional sampled
+        request appends to a bounded deque)."""
+        frac = swap_sample_fraction()
+        if frac <= 0.0:
+            return
+        with self._cap_lock:
+            self._cap_acc += frac
+            if self._cap_acc < 1.0:
+                return
+            self._cap_acc -= 1.0
+            self._capture.append(req)
+        metrics.count("swap.shadow.sampled")
+
+    def capture_samples(self) -> list:
+        with self._cap_lock:
+            return list(self._capture)
 
     def close(self) -> None:
         """Release on eviction: stop the dispatcher's drain thread so
         the evicted stack cannot outlive its handles; graph/native/mmap
-        memory frees with the last reference."""
+        memory frees with the last reference. Carried decode state
+        (matcher/incremental.py) built against this graph is flushed
+        first — an evicted or swapped-out city must not leave per-trace
+        Viterbi state keyed to a dead graph."""
+        try:
+            table = getattr(self.service.matcher,
+                            "_incremental_table", None)
+            if table is not None:
+                table.clear()
+        except Exception as e:
+            logger.warning("evicting %s: incremental-state flush "
+                           "failed: %s", self.name, e)
         try:
             self.service.dispatcher.close()
         except Exception as e:
@@ -92,6 +167,7 @@ class CityEntry:
                 # working; a cold load shows 0 / all-miss
                 "warmed_pairs": self.warmed_pairs,
                 "route_memo": memo,
+                "map_version": self.map_version,
                 "datastore": self.service.datastore is not None}
 
 
@@ -119,6 +195,11 @@ class CityRegistry:
         # multi-second city load; order is always _lock -> _reslock
         self._reslock = _locks.new_lock("datastore.cities.resident")
         self._resident: "OrderedDict[str, CityEntry]" = OrderedDict()
+        # swap bookkeeping (guarded by _reslock): the last swap record
+        # per city plus flip/refusal totals — /health's swap block
+        self._swap_last: Dict[str, dict] = {}
+        self._swap_flips = 0
+        self._swap_refusals = 0
 
     @property
     def budget_bytes(self) -> int:
@@ -230,31 +311,61 @@ class CityRegistry:
                 entry = CityEntry(name, service, size)
             else:
                 entry = self._load_from_config(name)
-            # pre-warm AFTER the stack is wired: the profile artifact's
-            # resident pairs land in the fresh native memo so the first
-            # request batch hits instead of running every Dijkstra cold
-            from ..datastore import load_profile, warm_matcher
-            from ..datastore.profile import profile_path
-            conf = self.config.get(name, {})
-            ppath = conf.get("profile")
-            if ppath is None and conf.get("datastore"):
-                ppath = profile_path(conf["datastore"])
-            if ppath is None and entry.service.datastore is not None:
-                ppath = profile_path(entry.service.datastore.root)
-            if ppath:
-                try:
-                    entry.warmed_pairs = warm_matcher(
-                        entry.service.matcher, load_profile(ppath))
-                except Exception as e:
-                    # the pre-warm is an optimisation: it must never
-                    # cost the city load
-                    logger.warning("profile pre-warm of %s failed "
-                                   "(loading cold): %s", name, e)
-            metrics.count("datastore.city.loads")
-            logger.info("city %s resident: %.1f MB, %d memo pairs "
-                        "pre-warmed", name, entry.size_bytes / 1e6,
-                        entry.warmed_pairs)
+            self._finish_load(name, entry)
             return entry
+
+    def _finish_load(self, name: str, entry: CityEntry) -> None:
+        """Wire-up common to every load path (config, loader, swap
+        candidate): profile pre-warm, map-version stamping, counters."""
+        # pre-warm AFTER the stack is wired: the profile artifact's
+        # resident pairs land in the fresh native memo so the first
+        # request batch hits instead of running every Dijkstra cold
+        from ..datastore import load_profile, warm_matcher
+        from ..datastore.profile import profile_path
+        conf = self.config.get(name, {})
+        ppath = conf.get("profile")
+        if ppath is None and conf.get("datastore"):
+            ppath = profile_path(conf["datastore"])
+        if ppath is None and entry.service.datastore is not None:
+            ppath = profile_path(entry.service.datastore.root)
+        if ppath:
+            try:
+                entry.warmed_pairs = warm_matcher(
+                    entry.service.matcher, load_profile(ppath))
+            except Exception as e:
+                # the pre-warm is an optimisation: it must never
+                # cost the city load
+                logger.warning("profile pre-warm of %s failed "
+                               "(loading cold): %s", name, e)
+        # content-derived map version (graph/version.py): the graph's
+        # persisted columns plus the committed profile artifact — two
+        # builds with identical bytes share a version, any change
+        # mints a new epoch
+        try:
+            from ..graph.version import map_version as _map_version
+            extra = None
+            if ppath and os.path.exists(ppath):
+                with open(ppath, "rb") as fh:
+                    extra = fh.read()
+            entry.map_version = _map_version(entry.service.matcher.net,
+                                             extra=extra)
+        except Exception as e:
+            logger.warning("map version of %s unavailable: %s", name, e)
+        # the version stamps the city's datastore: epoch-qualified
+        # ledger keys and manifest epoch tags (datastore/store.py)
+        # keep histograms from mixing map builds across a swap
+        if entry.map_version is not None \
+                and entry.service.datastore is not None:
+            try:
+                entry.service.datastore.set_map_version(
+                    entry.map_version)
+            except Exception as e:
+                logger.warning("stamping %s datastore with map %s "
+                               "failed: %s", name, entry.map_version, e)
+        metrics.count("datastore.city.loads")
+        logger.info("city %s resident: %.1f MB, %d memo pairs "
+                    "pre-warmed, map %s", name, entry.size_bytes / 1e6,
+                    entry.warmed_pairs, entry.map_version)
 
     def _load_from_config(self, name: str) -> CityEntry:
         from ..graph.network import RoadNetwork
@@ -289,6 +400,253 @@ class CityRegistry:
             entry.close()
         return True
 
+    # -- zero-downtime map swap --------------------------------------------
+    def swap(self, name: str, new_source=None,
+             force: Optional[bool] = None) -> dict:
+        """Hot-swap city ``name`` to a new map build with zero downtime.
+
+        ``new_source`` is the next version's source: a config dict
+        (replaces ``self.config[name]``) or a zero-arg callable
+        returning ``(service, size_bytes_or_None)`` (the loader-style
+        spelling tests and harnesses use); ``None`` reloads from the
+        current config/loader. The candidate stack loads and pre-warms
+        BESIDE the serving one — both versions count against the
+        residency budget for the duration — then the dual-version
+        shadow gate re-scores the capture ring on both graphs and the
+        flip happens at a request boundary: in-flight requests finish
+        on vN through their pins (release() closes vN's stack at the
+        last unpin), new requests route to vN+1.
+
+        The swap REFUSES (returns a ``refused_*`` record, old version
+        keeps serving) rather than evict an unrelated PINNED city for
+        room, and when shadow agreement falls below
+        ``REPORTER_TPU_SWAP_AGREEMENT`` — unless ``force=True`` /
+        ``REPORTER_TPU_SWAP_FORCE=1`` (an intentional map change
+        legitimately rewrites segment ids). Every outcome is counted
+        (``swap.flips`` / ``swap.refusals``) and surfaced on /health's
+        swap block; the returned record carries ``result`` =
+        ``flipped`` / ``loaded`` / ``refused_budget`` /
+        ``refused_shadow``."""
+        from ..utils import faults
+        forced = swap_force() if force is None else bool(force)
+        with self._lock:  # lint: ignore[LD003]
+            prev_conf = self.config.get(name)
+            if new_source is not None and not callable(new_source):
+                self.config[name] = dict(new_source)
+            cand = None
+            try:
+                if callable(new_source):
+                    service, size = new_source()
+                    if size is None:
+                        size = _graph_bytes(service.matcher.net)
+                    cand = CityEntry(name, service, size)
+                    with metrics.timer("datastore.city.load"):
+                        self._finish_load(name, cand)
+                else:
+                    if self.loader is None and name not in self.config:
+                        raise KeyError(
+                            f"unknown city {name!r}; configured: "
+                            f"{sorted(self.config)}")
+                    cand = self._load(name)
+                old = self._hit(name, pin=False)
+                if old is None:
+                    # nothing resident to shadow against: a plain
+                    # (budgeted) load of the new version
+                    record = {"city": name, "from": None,
+                              "to": cand.map_version,
+                              "agreement": None, "checks": 0,
+                              "forced": forced, "result": "loaded"}
+                    self._admit(name, cand, record)
+                    return record
+                record = {"city": name, "from": old.map_version,
+                          "to": cand.map_version, "forced": forced}
+                # residency: both versions are resident through the
+                # shadow window and both count against the budget.
+                # Unpinned unrelated LRU cities are evicted for room;
+                # a PINNED unrelated city refuses the swap instead
+                # (it is mid-request — the swap is the optional party
+                # here). old+candidate alone over budget still
+                # proceeds: the swapping city must serve (the same
+                # one-oversized-city rule as get()), and the overshoot
+                # ends when vN closes at the flip.
+                evicted = []
+                refused_for = None
+                with self._reslock:
+                    budget = self.budget_bytes
+                    for ename in [n for n in list(self._resident)
+                                  if n != name]:
+                        total = cand.size_bytes + sum(
+                            e.size_bytes
+                            for e in self._resident.values())
+                        if total <= budget:
+                            break
+                        e = self._resident[ename]
+                        if e._refs > 0:
+                            continue  # pinned: a swap never evicts it
+                        del self._resident[ename]
+                        e._evicted = True
+                        metrics.count("datastore.city.evictions")
+                        evicted.append((ename, e))
+                    total = cand.size_bytes + sum(
+                        e.size_bytes for e in self._resident.values())
+                    if total > budget:
+                        pinned = sorted(
+                            n for n in self._resident if n != name
+                            and self._resident[n]._refs > 0)
+                        if pinned:
+                            refused_for = pinned
+                for ename, e in evicted:
+                    logger.info("evicting city %s (%.1f MB) for the "
+                                "swap of %s", ename,
+                                e.size_bytes / 1e6, name)
+                    e.close()
+                if refused_for is not None:
+                    record["pinned"] = refused_for
+                    self._restore_conf(name, prev_conf, new_source)
+                    return self._refuse(name, cand, record,
+                                        "refused_budget")
+                # dual-version shadow gate: re-score the serving
+                # entry's capture ring on BOTH stacks (off the hot
+                # path — the handler threads keep routing to vN) and
+                # compare segment-id sequences. An empty ring passes
+                # vacuously: a city with no sampled traffic has
+                # nothing to disagree about.
+                checks = agree = 0
+                for sub in old.capture_samples():
+                    va = self._shadow_score(old.service, sub)
+                    vb = self._shadow_score(cand.service, sub)
+                    checks += 1
+                    metrics.count("swap.shadow.checks")
+                    if va == vb:
+                        agree += 1
+                        metrics.count("swap.shadow.agree")
+                    else:
+                        metrics.count("swap.shadow.mismatch")
+                agreement = (agree / checks) if checks else 1.0
+                record["agreement"] = round(agreement, 4)
+                record["checks"] = checks
+                floor = swap_agreement_floor()
+                if agreement < floor and not forced:
+                    record["floor"] = floor
+                    self._restore_conf(name, prev_conf, new_source)
+                    return self._refuse(name, cand, record,
+                                        "refused_shadow")
+                # the widest chaos window: candidate loaded, warmed
+                # and gated; vN still serving; nothing flipped yet
+                faults.failpoint("city.swap")
+                with self._reslock:
+                    self._resident[name] = cand
+                    # lint: ignore[LD001] — same _reslock-guards-the-
+                    # map rule as _hit
+                    self._resident.move_to_end(name)
+                    old._evicted = True
+                    close_old_now = old._refs <= 0
+                    record["result"] = "flipped"
+                    self._swap_last[name] = record
+                    self._swap_flips += 1
+                metrics.count("swap.flips")
+                logger.info(
+                    "city %s swapped map %s -> %s (agreement %.4f "
+                    "over %d checks%s)", name, record["from"],
+                    record["to"], agreement, checks,
+                    ", FORCED" if forced and agreement < floor else "")
+                if close_old_now:
+                    old.close()
+                # an explicit epoch event on the new version's change
+                # feed: /feed subscribers learn the map changed (and
+                # must resync) even before any vN+1 deltas land
+                ds = cand.service.datastore
+                if ds is not None \
+                        and getattr(ds, "freshness", None) is not None:
+                    try:
+                        ds.freshness.feed.publish_epoch(
+                            cand.map_version)
+                    except Exception as e:
+                        logger.warning("epoch feed event for %s "
+                                       "failed: %s", name, e)
+                return record
+            except BaseException:
+                self._restore_conf(name, prev_conf, new_source)
+                if cand is not None:
+                    try:
+                        cand.close()
+                    except Exception:
+                        pass
+                raise
+
+    def _restore_conf(self, name: str, prev_conf, new_source) -> None:
+        # swap() (the only caller) holds _lock — the config guard —
+        # for this whole call; the per-function pass can't see that
+        if new_source is None or callable(new_source):
+            return
+        if prev_conf is None:
+            self.config.pop(name, None)  # lint: ignore[LD001]
+        else:
+            self.config[name] = prev_conf  # lint: ignore[LD001]
+
+    def _refuse(self, name: str, cand: CityEntry, record: dict,
+                result: str) -> dict:
+        record["result"] = result
+        # _reslock guards the swap bookkeeping (the caller additionally
+        # holds _lock; the lint reads neither through the call)
+        with self._reslock:
+            self._swap_last[name] = record  # lint: ignore[LD001]
+            self._swap_refusals += 1
+        metrics.count("swap.refusals")
+        logger.warning("swap of city %s REFUSED (%s); map %s keeps "
+                       "serving: %s", name, result, record.get("from"),
+                       record)
+        try:
+            cand.close()
+        except Exception:
+            pass
+        return record
+
+    def _admit(self, name: str, entry: CityEntry, record: dict) -> None:
+        """Insert a swap-loaded entry for a non-resident city with the
+        same budget policy as get()."""
+        evicted = []
+        # _reslock guards the resident map here exactly as in get()
+        # (the caller, swap(), additionally holds _lock)
+        with self._reslock:
+            self._resident[name] = entry  # lint: ignore[LD001]
+            budget = self.budget_bytes
+            while len(self._resident) > 1 and \
+                    sum(e.size_bytes for e
+                        in self._resident.values()) > budget:
+                # lint: ignore[LD001] — same _reslock critical section
+                ename, e = self._resident.popitem(last=False)
+                e._evicted = True
+                metrics.count("datastore.city.evictions")
+                if e._refs <= 0:
+                    evicted.append((ename, e))
+            self._swap_last[name] = record  # lint: ignore[LD001]
+        for ename, e in evicted:
+            logger.info("evicting city %s (%.1f MB) over the "
+                        "residency budget", ename, e.size_bytes / 1e6)
+            e.close()
+
+    @staticmethod
+    def _shadow_score(service, sub: dict):
+        """The segment-id sequence one version's stack reports for a
+        captured request — the shadow gate's comparison key. Non-200
+        outcomes compare by status (both versions rejecting a request
+        the same way is agreement)."""
+        try:
+            status, body = service.handle(dict(sub))
+        except Exception as e:
+            return ("error", str(e))
+        if status != 200:
+            return (status,)
+        if isinstance(body, (bytes, bytearray, memoryview)):
+            body = bytes(body).decode("utf-8")
+        try:
+            doc = json.loads(body)
+        except Exception:
+            return ("unparseable",)
+        segs = (doc.get("segment_matcher") or {}).get("segments") or []
+        return (200, tuple(s.get("segment_id") for s in segs))
+
     # -- introspection -----------------------------------------------------
     def snapshot(self) -> dict:
         # tiny lock only: /health and /profile must never wait out a
@@ -296,12 +654,21 @@ class CityRegistry:
         # on the copied list
         with self._reslock:
             entries = list(self._resident.items())
+            swap = {"flips": self._swap_flips,
+                    "refusals": self._swap_refusals,
+                    "last": {c: dict(r)
+                             for c, r in self._swap_last.items()}}
         resident = {name: e.snapshot() for name, e in entries}
         return {"budget_bytes": self.budget_bytes,
                 "resident_bytes": sum(e["size_bytes"]
                                       for e in resident.values()),
                 "configured": sorted(self.config),
-                "resident": resident}
+                "resident": resident,
+                # map-lifecycle view: flip/refusal totals plus the
+                # last swap record per city (/health's swap block)
+                "swap": swap}
 
 
-__all__ = ["CityRegistry", "CityEntry", "city_budget_bytes"]
+__all__ = ["CityRegistry", "CityEntry", "city_budget_bytes",
+           "swap_sample_fraction", "swap_agreement_floor",
+           "swap_window", "swap_force"]

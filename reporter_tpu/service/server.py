@@ -199,10 +199,23 @@ class ReporterService:
                         {"error": "overloaded", "reason": shed.reason,
                          "retry_after_s": shed.retry_after_s})
                 try:
-                    return entry.service.handle(sub)
+                    status, body = entry.service.handle(sub)
                 finally:
                     gate.release()
-            return getattr(entry.service, method)(sub)
+            elif method == "handle":
+                status, body = entry.service.handle(sub)
+            else:
+                return getattr(entry.service, method)(sub)
+            if status == 200:
+                # swap shadow capture (service/cities.py): sampled
+                # admitted traffic is the corpus the dual-version
+                # gate re-scores on a candidate graph at swap time.
+                # getattr: registries are duck-typed (tests stub them)
+                # and capture is best-effort, never request-fatal.
+                observe = getattr(entry, "observe", None)
+                if observe is not None:
+                    observe(sub)
+            return status, body
         finally:
             self.cities.release(entry)
 
@@ -261,6 +274,12 @@ class ReporterService:
         window = params.get("window")
         if window is not None:
             self.datastore.enable_freshness()
+        # epoch pin/merge (datastore/__init__.py): map_version= pins
+        # the sweep to one map build, merge=1 explicitly mixes epochs;
+        # the default pins to the store's active version
+        mv = params.get("map_version")
+        mv = str(mv) if mv is not None else None
+        merge = bool(params.get("merge"))
         try:
             if bbox is not None:
                 if params.get("level") is None:
@@ -270,15 +289,18 @@ class ReporterService:
                     bbox, int(params["level"]), hours=hours,
                     percentiles=pcts,
                     max_segments=params.get("max_segments"),
-                    window=window)
+                    window=window, map_version=mv, merge=merge)
             elif segs is not None:
                 result = {"results": self.datastore.query_many(
                     [int(s) for s in segs], hours=hours,
-                    percentiles=pcts, window=window)}
+                    percentiles=pcts, window=window,
+                    map_version=mv, merge=merge)}
             else:
                 result = self.datastore.query(int(seg), hours=hours,
                                               percentiles=pcts,
-                                              window=window)
+                                              window=window,
+                                              map_version=mv,
+                                              merge=merge)
         except (TypeError, ValueError) as e:
             return 400, json.dumps({"error": str(e)})
         return 200, json.dumps(result, separators=(",", ":"))
@@ -339,10 +361,20 @@ class ReporterService:
         m = self.matcher
         circuit = m.circuit.snapshot()
         open_domains = m.open_domains()
+        try:
+            from ..graph.version import map_version as _map_version
+            graph_version = _map_version(m.net) if m.net is not None \
+                else None
+        except Exception:
+            graph_version = None
         body = {
             "graph": {"loaded": m.net is not None,
                       "nodes": int(m.net.num_nodes),
-                      "edges": int(m.net.num_edges)},
+                      "edges": int(m.net.num_edges),
+                      # content-derived map identity (graph/version.py)
+                      # of the DEFAULT stack; per-city versions live in
+                      # the cities block below
+                      "map_version": graph_version},
             "native": {"status": "native" if m.runtime is not None
                        else "fallback"},
             "circuit": circuit,
@@ -591,6 +623,14 @@ def make_handler(service: ReporterService):
             # ?window=5m|300s|inf — freshness-tier staleness bound
             if "window" in params:
                 out["window"] = params["window"][0]
+            # ?map_version=abc123def456 — pin the sweep to one map
+            # epoch; ?merge=1 — explicit opt-in to sweep every epoch
+            # (default pins to the store's active version)
+            if "map_version" in params:
+                out["map_version"] = params["map_version"][0]
+            if "merge" in params:
+                out["merge"] = params["merge"][0].lower() \
+                    not in ("", "0", "off", "false")
             # ?viewport=1 — materialised tile summaries for bbox+level
             if "viewport" in params:
                 out["viewport"] = params["viewport"][0].lower() \
@@ -893,8 +933,21 @@ def main(argv=None):
         if conf.get("cities"):
             from .cities import CityRegistry
             cities = CityRegistry(conf["cities"])
-        return ReporterService(SegmentMatcher(), datastore=datastore,
-                               cities=cities)
+        service = ReporterService(SegmentMatcher(), datastore=datastore,
+                                  cities=cities)
+        # stamp the default stack's store with its graph epoch, the
+        # same contract as a CityRegistry load (cities.py): the
+        # /histogram default pin must track the graph THIS process
+        # serves — without the stamp a restart forgets the active
+        # epoch and the default query silently mixes map builds
+        if datastore is not None \
+                and service.matcher.net is not None:
+            from ..graph.version import map_version as _mv
+            try:
+                datastore.set_map_version(_mv(service.matcher.net))
+            except Exception as e:
+                sys.stderr.write(f"map version stamp failed: {e}\n")
+        return service
 
     if procs > 1:
         from .prefork import serve_prefork

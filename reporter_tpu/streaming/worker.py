@@ -106,8 +106,14 @@ class StreamWorker:
                  incremental_probe: Optional[
                      Callable[[], Optional[dict]]] = None,
                  on_evict: Optional[Callable[[str], None]] = None,
-                 datastore=None, compactor=None):
+                 datastore=None, compactor=None,
+                 map_version: Optional[str] = None):
         self.formatter = formatter
+        # content-derived identity of the graph this worker matches
+        # against (graph/version.py), surfaced in the heartbeat so a
+        # fleet's log pipeline can see which map build each worker is
+        # on across a swap; None when the owner wired no graph probe
+        self.map_version = map_version
         # multi-host: predicate deciding which uuids this worker owns
         # (parallel.multihost — the Kafka keyed-partition contract when the
         # input stream is not already partitioned); None = own everything
@@ -338,6 +344,10 @@ class StreamWorker:
             # wired, or the table was never built)
             "incremental": self.incremental_probe()
             if self.incremental_probe else None,
+            # which map build this worker matches against (None until
+            # the owner wires it): swaps are visible per worker in the
+            # heartbeat stream, not just on the serving tier's /health
+            "map_version": self.map_version,
         }, separators=(",", ":")))
 
     def _flush_tiles(self) -> None:
@@ -638,6 +648,19 @@ def main(argv=None):
         circuit_probe=circuit_probe, degraded_probe=degraded_probe,
         incremental_probe=incremental_probe, on_evict=on_evict,
         datastore=datastore, compactor=compactor)
+    if not args.reporter_url:
+        # in-process matching: the heartbeat carries the graph's
+        # content-derived map version (an HTTP split can't know the
+        # remote matcher's build). NOTE: the tee's ledger keys stay
+        # UNversioned here — only a map-version OWNER (the city
+        # registry's swap machinery, harnesses) stamps the store, so a
+        # single-map worker's crash-replay dedupe is byte-compatible
+        # with pre-versioning spools
+        try:
+            from ..graph.version import map_version as _map_version
+            worker.map_version = _map_version(service.matcher.net)
+        except Exception:
+            pass
     if not args.reporter_url:
         # poisoned-trace quarantine lands in THIS worker's trace spool
         # (explicit beats the last-writer-wins module global — see

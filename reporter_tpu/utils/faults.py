@@ -76,6 +76,7 @@ KNOWN_SITES = frozenset({
     "datastore.compact", "datastore.lease", "state.save",
     "worker.offer", "worker.post_egress", "wire.native",
     "admission.gate", "route.device", "match.incremental.commit",
+    "city.swap",
 })
 
 #: sites that place an ``after=True`` hook (the only position where

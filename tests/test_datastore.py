@@ -193,6 +193,114 @@ class TestStore:
         assert r["transitions"] == []
 
 
+class TestEpochs:
+    """Epoch'd histograms (ISSUE 20): the store's active map_version
+    stamps manifests and ledger keys, compaction groups by epoch, and
+    queries pin to ONE epoch by default with ``merge=`` the explicit
+    opt-in — histograms never silently mix map builds."""
+
+    MV_A = "aaaa00000001"
+    MV_B = "bbbb00000002"
+
+    def _two_epoch_store(self, tmp_path):
+        ds = LocalDatastore(str(tmp_path))
+        ds.set_map_version(self.MV_A)
+        ds.ingest_segments(_segs(4), ingest_key="k1")
+        ds.set_map_version(self.MV_B)
+        # same cell, slower traffic: the epochs must stay tellable
+        ds.ingest_segments(_segs(4, duration=20.0), ingest_key="k2")
+        return ds
+
+    def test_ledger_keys_are_epoch_qualified(self, tmp_path):
+        ds = LocalDatastore(str(tmp_path))
+        ds.set_map_version(self.MV_A)
+        assert ds.ingest_segments(_segs(3), ingest_key="k") == 3
+        # same key, same epoch: exactly-once dedupe as ever
+        assert ds.ingest_segments(_segs(3), ingest_key="k") == 0
+        # same key, NEW epoch: the post-swap re-score of the same
+        # traffic is new data, not a duplicate
+        ds.set_map_version(self.MV_B)
+        assert ds.ingest_segments(_segs(3), ingest_key="k") == 3
+
+    def test_manifest_epoch_tags_and_counter(self, tmp_path):
+        from reporter_tpu.utils import metrics
+        c0 = metrics.default.counter("datastore.epoch.stamped_segments")
+        ds = self._two_epoch_store(tmp_path)
+        pdir = ds.partition_dir(2, 756425)
+        manifest = json.load(open(os.path.join(pdir, "MANIFEST.json")))
+        assert manifest["map_version"] == self.MV_B
+        tags = manifest["epochs"]
+        assert set(tags) == set(manifest["segments"])
+        assert sorted(tags.values()) == [self.MV_A, self.MV_B]
+        assert metrics.default.counter(
+            "datastore.epoch.stamped_segments") == c0 + 2
+
+    def test_default_pin_is_active_version_merge_is_opt_in(self,
+                                                          tmp_path):
+        from reporter_tpu.utils import metrics
+        ds = self._two_epoch_store(tmp_path)
+        p0 = metrics.default.counter("datastore.epoch.pinned_queries")
+        m0 = metrics.default.counter("datastore.epoch.merged_queries")
+        latest = ds.query(SID)
+        pin_a = ds.query(SID, map_version=self.MV_A)
+        pin_b = ds.query(SID, map_version=self.MV_B)
+        merged = ds.query(SID, merge=True)
+        assert latest == pin_b  # default = the ACTIVE version
+        assert pin_a["mean_kph"] != pin_b["mean_kph"]
+        assert merged["count"] == pin_a["count"] + pin_b["count"]
+        assert metrics.default.counter(
+            "datastore.epoch.pinned_queries") == p0 + 3
+        assert metrics.default.counter(
+            "datastore.epoch.merged_queries") == m0 + 1
+        with pytest.raises(ValueError):
+            ds.query(SID, map_version=self.MV_A, merge=True)
+
+    def test_query_many_and_bbox_thread_the_pin(self, tmp_path):
+        ds = self._two_epoch_store(tmp_path)
+        (one_a,) = ds.query_many([SID], map_version=self.MV_A)
+        assert one_a == ds.query(SID, map_version=self.MV_A)
+        (one_m,) = ds.query_many([SID], merge=True)
+        assert one_m == ds.query(SID, merge=True)
+        bb = ds.query_bbox((-180, -90, 180, 90), 2,
+                           map_version=self.MV_A)
+        assert bb["segments"][0] == dict(one_a, segment_id=SID)
+
+    def test_compaction_groups_by_epoch(self, tmp_path):
+        """One base per EPOCH — compaction never merges across map
+        versions, and every pinned answer is byte-stable across it."""
+        ds = LocalDatastore(str(tmp_path))
+        ds.set_map_version(self.MV_A)
+        for k in range(2):
+            ds.ingest_segments(_segs(3), ingest_key=f"a{k}")
+        ds.set_map_version(self.MV_B)
+        for k in range(2):
+            ds.ingest_segments(_segs(3, duration=20.0),
+                               ingest_key=f"b{k}")
+        pin_a = ds.query(SID, map_version=self.MV_A)
+        pin_b = ds.query(SID, map_version=self.MV_B)
+        merged = ds.query(SID, merge=True)
+        assert ds.compact()["merged_segments"] == 4
+        pdir = ds.partition_dir(2, 756425)
+        manifest = json.load(open(os.path.join(pdir, "MANIFEST.json")))
+        assert len(manifest["segments"]) == 2
+        assert sorted(manifest["epochs"].values()) \
+            == [self.MV_A, self.MV_B]
+        assert ds.query(SID, map_version=self.MV_A) == pin_a
+        assert ds.query(SID, map_version=self.MV_B) == pin_b
+        assert ds.query(SID, merge=True) == merged
+
+    def test_untagged_legacy_segments_pass_any_pin(self, tmp_path):
+        """Enabling versioning on an existing store hides nothing:
+        pre-versioning segments (no epoch tag) serve under every pin."""
+        ds = LocalDatastore(str(tmp_path))
+        ds.ingest_segments(_segs(3), ingest_key="legacy")
+        before = ds.query(SID)
+        ds.set_map_version(self.MV_A)
+        assert ds.query(SID) == before  # default pin
+        assert ds.query(SID, map_version="ffff00000009") == before
+        assert ds.query(SID, merge=True) == before
+
+
 class TestIngestDir:
     def _flush_layout(self, root, segs, name="rtpu.abc123"):
         tile_dir = os.path.join(root, "1483344000_1483347599", "2", "756425")
@@ -428,6 +536,30 @@ class TestHistogramAction:
         assert body["count"] == 20
         assert body["mean_kph"] == pytest.approx(36.0)
         assert body["transitions"][0]["next_id"] == NID
+
+    def test_epoch_pin_and_merge_params(self, histogram_server):
+        """/histogram grows map_version= (pin) and merge=1 (explicit
+        cross-epoch opt-in); the default pins to the store's active
+        version, and pin+merge together is a 400 (ISSUE 20)."""
+        url, ds = histogram_server
+        ds.set_map_version("aaaa00000001")
+        ds.ingest_segments(_segs(4), ingest_key="ea")
+        ds.set_map_version("bbbb00000002")
+        ds.ingest_segments(_segs(4, duration=20.0), ingest_key="eb")
+        _, latest = _get(f"{url}/histogram?segment_id={SID}")
+        _, pin_a = _get(f"{url}/histogram?segment_id={SID}"
+                        f"&map_version=aaaa00000001")
+        _, pin_b = _get(f"{url}/histogram?segment_id={SID}"
+                        f"&map_version=bbbb00000002")
+        _, merged = _get(f"{url}/histogram?segment_id={SID}&merge=1")
+        assert latest == pin_b  # default = the active epoch
+        # 20 legacy (untagged, pre-versioning) rows serve under every
+        # pin; each epoch adds its own 4
+        assert pin_a["count"] == 24 and pin_b["count"] == 24
+        assert merged["count"] == 28
+        code, body = _get(f"{url}/histogram?segment_id={SID}"
+                          f"&map_version=aaaa00000001&merge=1")
+        assert code == 400 and "mutually exclusive" in body["error"]
 
     def test_get_hours_range(self, histogram_server):
         url, _ds = histogram_server

@@ -292,6 +292,43 @@ class TestFailpointSites:
         finally:
             admission._reset_module()
 
+    def test_city_swap_failpoint(self):
+        """city.swap sits in the WIDEST swap window — candidate loaded
+        and shadow-gated, old version still serving, nothing flipped:
+        a fault there must abort the swap with the OLD entry still
+        resident and serving, and a retry after disarm flips cleanly
+        (tools/chaos.py swap_kill drives the crash kind in a real
+        subprocess)."""
+        from reporter_tpu.matcher import SegmentMatcher
+        from reporter_tpu.service.cities import CityRegistry
+        from reporter_tpu.service.server import ReporterService
+        from reporter_tpu.synth import build_grid_city
+
+        def fresh_service():
+            city = build_grid_city(rows=5, cols=5, spacing_m=200.0,
+                                   seed=3, service_road_fraction=0.0,
+                                   internal_fraction=0.0)
+            return ReporterService(SegmentMatcher(net=city))
+
+        reg = CityRegistry(loader=lambda name: (fresh_service(), None),
+                           budget_bytes=1 << 30)
+        old = reg.get("metro")
+        flips = metrics.default.counter("swap.flips")
+        faults.configure("city.swap=error#1")
+        with pytest.raises(faults.FaultError):
+            reg.swap("metro", lambda: (fresh_service(), None))
+        faults.clear()
+        # the failed swap changed nothing: same entry, still serving,
+        # no flip counted
+        assert reg.get("metro") is old
+        assert not old._evicted
+        assert metrics.default.counter("swap.flips") == flips
+        # disarmed retry flips
+        rec = reg.swap("metro", lambda: (fresh_service(), None))
+        assert rec["result"] == "flipped"
+        assert reg.get("metro") is not old
+        assert metrics.default.counter("swap.flips") == flips + 1
+
     def test_state_save_failpoint(self, tmp_path):
         from reporter_tpu.streaming.anonymiser import Anonymiser, TileSink
         from reporter_tpu.streaming.batcher import PointBatcher

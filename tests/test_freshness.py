@@ -270,6 +270,37 @@ class TestChangeFeed:
         with pytest.raises(ValueError):
             feed.poll(bbox=WORLD, cursor=0, timeout_s=0)  # needs level
 
+    def test_delta_events_carry_map_version(self, tmp_path):
+        """A stamped store's ingest hook threads the active epoch into
+        every delta event (ISSUE 20)."""
+        ds = LocalDatastore(str(tmp_path))
+        tier = ds.enable_freshness()
+        ds.set_map_version("aaaa00000001")
+        ds.ingest_segments(_segs(2), ingest_key="k1")
+        out = tier.feed.poll(cursor=0, timeout_s=0)
+        (ev,) = out["events"]
+        assert ev["kind"] == "delta"
+        assert ev["map_version"] == "aaaa00000001"
+
+    def test_epoch_event_bypasses_viewport_filters(self):
+        """publish_epoch announces a map flip to EVERY subscriber —
+        whatever bbox/level a dashboard watches, its history predates
+        the new map, so the event must reach it."""
+        from reporter_tpu.utils import metrics
+        c0 = metrics.default.counter("datastore.epoch.events")
+        feed = self._feed()
+        feed.publish_epoch("bbbb00000002")
+        assert metrics.default.counter(
+            "datastore.epoch.events") == c0 + 1
+        # a far-away viewport that filters out every delta still sees
+        # the epoch boundary
+        out = feed.poll(bbox=[0.0, 0.0, 0.1, 0.1], level=2, cursor=0,
+                        timeout_s=0)
+        (ev,) = out["events"]
+        assert ev["kind"] == "epoch"
+        assert ev["map_version"] == "bbbb00000002"
+        assert ev["segments"] == [] and ev["rows"] == 0
+
     def test_waiter_cap_sheds_explicitly(self):
         feed = self._feed(max_waiters_n=0)
         with pytest.raises(FeedOverload) as exc:

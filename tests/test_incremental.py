@@ -214,6 +214,50 @@ class TestSerde:
         assert served > 0
         assert m2.incremental_table.resets == 0
 
+    def test_blob_roundtrip_carries_map_version(self, city, matcher):
+        """Carried state is keyed to the map build that produced it
+        (ISSUE 20): the v2 blob trailer round-trips the graph's
+        content-derived version."""
+        pts = list(make_trace(city, seed=77, noise=5.0).points)
+        stream_parity(matcher, pts, "epoch-0")
+        table = matcher.incremental_table
+        assert table.map_version
+        assert table.gauge()["map_version"] == table.map_version
+        blobs = table.to_blobs()
+        assert blobs
+        st = inc.CarriedState.from_bytes(blobs[0][1])
+        assert st.map_version == table.map_version
+        # an unversioned state (ver-1 era) round-trips None
+        bare = inc.CarriedState((1.0, 2.0), False, 4, map_version=None)
+        assert inc.CarriedState.from_bytes(
+            bare.to_bytes()).map_version is None
+
+    def test_swap_resets_carried_state_against_new_graph(self, city,
+                                                         matcher):
+        """A hot map swap invalidates carried decode state: a restored
+        state from vN RESETS on the vN+1 table (batch-oracle re-frame)
+        instead of advancing a decode against the wrong graph, and the
+        replayed window still holds byte parity on the new graph."""
+        pts = list(make_trace(city, seed=78, noise=5.0).points)
+        mid = max(9, (len(pts) // 2) // 3 * 3)
+        stream_parity(matcher, pts[:mid], "swap-0")
+        blobs = matcher.incremental_table.to_blobs()
+        assert blobs
+
+        city2 = build_grid_city(rows=12, cols=12, spacing_m=200.0,
+                                seed=2, service_road_fraction=0.0,
+                                internal_fraction=0.0)
+        city2.edge_speed_kph = city2.edge_speed_kph * 1.3
+        m2 = SegmentMatcher(net=city2)
+        t2 = m2.incremental_table
+        assert t2.map_version != matcher.incremental_table.map_version
+        # the blobs parse fine (work avoidance is graph-agnostic)...
+        assert t2.restore_blobs(blobs) == len(blobs)
+        r0 = t2.resets
+        # ...but the first report on the new graph drops them
+        stream_parity(m2, pts, "swap-0", start=mid, step=3)
+        assert t2.resets > r0
+
     def test_corrupt_blob_is_skipped_not_fatal(self, city, matcher):
         n = matcher.incremental_table.restore_blobs(
             [("bad", b"\x00\x01garbage")])

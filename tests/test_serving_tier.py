@@ -835,6 +835,185 @@ class TestCityRegistry:
         assert b._refs == 0
 
 
+class TestMapSwap:
+    """Zero-downtime map lifecycle (ISSUE 20): the hot swap flips at a
+    request boundary behind the dual-version shadow gate, refuses
+    rather than evicting a pinned unrelated city, and in-flight pins
+    keep vN's stack alive through the flip."""
+
+    def _svc(self, city, tmp_path, name):
+        from reporter_tpu.matcher import SegmentMatcher
+        from reporter_tpu.service.server import ReporterService
+        seg = make_segment_id(2, 100, 1)
+        ds = _seed_store(tmp_path / f"store-{name}", [seg],
+                         deltas=1, n_obs=16)
+        return ReporterService(
+            SegmentMatcher(net=city, use_native=False), datastore=ds)
+
+    def test_swap_flips_to_new_version(self, synth_city, tmp_path):
+        from reporter_tpu.graph.version import map_version
+        from reporter_tpu.service.cities import CityRegistry
+        from reporter_tpu.synth import build_grid_city
+        city2 = build_grid_city(rows=7, cols=7, spacing_m=220.0,
+                                seed=11, service_road_fraction=0.0,
+                                internal_fraction=0.0)
+        city2.edge_speed_kph = city2.edge_speed_kph * 1.2
+        assert map_version(city2) != map_version(synth_city)
+        svc1 = self._svc(synth_city, tmp_path, "v1")
+        svc2 = self._svc(city2, tmp_path, "v2")
+        reg = CityRegistry(loader=lambda n: (svc1, 100),
+                           budget_bytes=1 << 30)
+        f0 = metrics.default.counter("swap.flips")
+        old = reg.get("metro")
+        assert old.map_version == map_version(synth_city)
+        # the load stamped the city's store: epoch-qualified ledger
+        # keys + manifest tags flow from here
+        assert old.service.datastore.map_version == old.map_version
+        rec = reg.swap("metro", lambda: (svc2, 100))
+        assert rec["result"] == "flipped"
+        assert rec["from"] == map_version(synth_city)
+        assert rec["to"] == map_version(city2)
+        assert metrics.default.counter("swap.flips") == f0 + 1
+        new = reg.get("metro")
+        assert new is not old and new.map_version == rec["to"]
+        assert new.service.datastore.map_version == rec["to"]
+        # unpinned vN closed at the flip boundary
+        with pytest.raises(RuntimeError):
+            old.service.dispatcher.submit({"uuid": "x", "trace": []})
+        snap = reg.snapshot()
+        assert snap["swap"]["flips"] == 1
+        assert snap["swap"]["last"]["metro"]["result"] == "flipped"
+        assert snap["resident"]["metro"]["map_version"] == rec["to"]
+
+    def test_pin_on_old_survives_flip_until_release(self, synth_city,
+                                                    tmp_path):
+        """In-flight requests finish on vN: a pin taken before the
+        flip keeps vN's dispatcher alive until the LAST release, while
+        new traffic already routes to vN+1."""
+        from reporter_tpu.service.cities import CityRegistry
+        svc1 = self._svc(synth_city, tmp_path, "p1")
+        svc2 = self._svc(synth_city, tmp_path, "p2")
+        reg = CityRegistry(loader=lambda n: (svc1, 100),
+                           budget_bytes=1 << 30)
+        old = reg.acquire("metro")  # pinned, as server._route does
+        rec = reg.swap("metro", lambda: (svc2, 100))
+        assert rec["result"] == "flipped"
+        # vN still serves the in-flight request through its pin...
+        old.service.dispatcher.submit_many([], return_exceptions=True)
+        # ...while new requests route to vN+1
+        assert reg.get("metro").service is svc2
+        reg.release(old)
+        with pytest.raises(RuntimeError):
+            old.service.dispatcher.submit({"uuid": "x", "trace": []})
+
+    def test_shadow_gate_refuses_divergent_graph(self, synth_city,
+                                                 tmp_path,
+                                                 monkeypatch):
+        from reporter_tpu.service.cities import CityRegistry
+        from reporter_tpu.synth import build_grid_city
+        monkeypatch.setenv("REPORTER_TPU_SWAP_SAMPLE", "1")
+        alien = build_grid_city(rows=5, cols=5, spacing_m=150.0,
+                                seed=2, service_road_fraction=0.0,
+                                internal_fraction=0.0)
+        svc1 = self._svc(synth_city, tmp_path, "s1")
+        svc2 = self._svc(alien, tmp_path, "s2")
+        reg = CityRegistry(loader=lambda n: (svc1, 100),
+                           budget_bytes=1 << 30)
+        old = reg.get("metro")
+        for req in _city_requests(synth_city, n=4):
+            old.observe(req)  # as server._route does on admitted 200s
+        r0 = metrics.default.counter("swap.refusals")
+        rec = reg.swap("metro", lambda: (svc2, 100))
+        assert rec["result"] == "refused_shadow"
+        assert rec["checks"] == 4 and rec["agreement"] < rec["floor"]
+        assert metrics.default.counter("swap.refusals") == r0 + 1
+        # the old version keeps serving; the candidate was closed
+        assert reg.get("metro") is old
+        snap = reg.snapshot()["swap"]
+        assert snap["refusals"] == 1
+        assert snap["last"]["metro"]["result"] == "refused_shadow"
+        with pytest.raises(RuntimeError):
+            svc2.dispatcher.submit({"uuid": "x", "trace": []})
+        # operator override: an intentional map change flips anyway
+        svc3 = self._svc(alien, tmp_path, "s3")
+        rec = reg.swap("metro", lambda: (svc3, 100), force=True)
+        assert rec["result"] == "flipped" and rec["forced"]
+
+    def test_eviction_flushes_incremental_state(self, synth_city,
+                                                tmp_path):
+        """An evicted city's carried incremental decode state flushes
+        with its stack (counted in match.incremental.evictions) — a
+        vacated slot must not leak per-trace device state."""
+        from reporter_tpu.service.cities import CityRegistry
+        svc1 = self._svc(synth_city, tmp_path, "e1")
+        svc2 = self._svc(synth_city, tmp_path, "e2")
+        services = {"a": svc1, "b": svc2}
+        reg = CityRegistry(loader=lambda n: (services[n], 100),
+                           budget_bytes=100)
+        a = reg.get("a")
+        req = _city_requests(synth_city, n=1)[0]
+        a.service.matcher.match_incremental(
+            [{"uuid": "evict-1", "trace": req["trace"]}])
+        table = a.service.matcher.incremental_table
+        assert table.gauge()["traces"] == 1
+        e0 = metrics.default.counter("match.incremental.evictions")
+        reg.get("b")  # budget of one city: evicts + closes a
+        assert table.gauge()["traces"] == 0
+        assert metrics.default.counter(
+            "match.incremental.evictions") == e0 + 1
+
+    def test_swap_publishes_epoch_feed_event(self, synth_city,
+                                             tmp_path):
+        """A flip announces the new epoch on the candidate store's
+        change feed — dashboards re-query instead of merging across
+        map builds (ISSUE 20)."""
+        from reporter_tpu.service.cities import CityRegistry
+        svc1 = self._svc(synth_city, tmp_path, "f1")
+        svc2 = self._svc(synth_city, tmp_path, "f2")
+        tier = svc2.datastore.enable_freshness()
+        assert tier is not None
+        reg = CityRegistry(loader=lambda n: (svc1, 100),
+                           budget_bytes=1 << 30)
+        reg.get("metro")
+        rec = reg.swap("metro", lambda: (svc2, 100))
+        assert rec["result"] == "flipped"
+        out = tier.feed.poll(cursor=0, timeout_s=0)
+        epochs = [e for e in out["events"] if e["kind"] == "epoch"]
+        assert epochs and epochs[-1]["map_version"] == rec["to"]
+
+    def test_budget_refusal_spares_pinned_city(self, synth_city,
+                                               tmp_path):
+        """Dual residency during the swap counts BOTH versions against
+        the byte budget; a pinned unrelated city refuses the swap
+        (never evicted mid-request), an unpinned one is evicted."""
+        from reporter_tpu.service.cities import CityRegistry
+        built = []
+
+        def loader(name):
+            svc = self._svc(synth_city, tmp_path, f"b{len(built)}")
+            built.append(name)
+            return svc, 100
+
+        reg = CityRegistry(loader=loader, budget_bytes=250)
+        reg.get("metro")
+        other = reg.acquire("other")  # pinned unrelated city
+        e0 = metrics.default.counter("datastore.city.evictions")
+        rec = reg.swap("metro")  # 100*3 > 250 with 'other' pinned
+        assert rec["result"] == "refused_budget"
+        assert rec["pinned"] == ["other"]
+        assert sorted(reg.snapshot()["resident"]) == ["metro", "other"]
+        assert metrics.default.counter(
+            "datastore.city.evictions") == e0
+        # unpinned: the unrelated LRU city is evicted and the swap
+        # proceeds
+        reg.release(other)
+        rec = reg.swap("metro")
+        assert rec["result"] == "flipped"
+        assert sorted(reg.snapshot()["resident"]) == ["metro"]
+        assert metrics.default.counter(
+            "datastore.city.evictions") == e0 + 1
+
+
 class TestServiceRouting:
     @pytest.fixture()
     def routed_service(self, synth_city, tmp_path):
@@ -925,6 +1104,21 @@ class TestServiceRouting:
             assert got["compaction"]["partitions_over"] == 1
         finally:
             service.dispatcher.close()
+
+    def test_health_surfaces_map_versions(self, routed_service,
+                                          synth_city):
+        """/health carries the default stack's graph map_version plus
+        the per-resident-city versions and the swap block (ISSUE 20)."""
+        from reporter_tpu.graph.version import map_version
+        service, seg, ds_b = routed_service
+        service.cities.get("b")  # make the routed city resident
+        code, body = service.health()
+        got = json.loads(body)
+        assert got["graph"]["map_version"] == map_version(synth_city)
+        resident = got["cities"]["resident"]["b"]
+        assert resident["map_version"] == map_version(synth_city)
+        swap = got["cities"]["swap"]
+        assert swap == {"flips": 0, "refusals": 0, "last": {}}
 
 
 class TestDatastoreCliBatched:

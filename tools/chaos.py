@@ -32,6 +32,14 @@ Scenarios (run the named ones, default ``storm kill_restore``):
                 -> manifests untorn -> another process steals the dead
                 holder's lease -> recovery replay ledger-deduped ->
                 store cells equal a fresh fault-free ingest
+  swap_kill     SIGKILL a registry worker in the WIDEST map-swap window
+                (candidate loaded + shadow-gated, old version serving,
+                lease held) -> lease steal clean -> recovery replays the
+                pre-swap tree under v1 (deduped) + post-swap tree under
+                v2 -> store cells equal a fault-free run, every base
+                segment tagged exactly one epoch, pinned views match;
+                a pre-swap dead-letter trace spool then drains through
+                the NEW graph without crashing
 
 Usage:
   REPORTER_TPU_PLATFORM=cpu python tools/chaos.py [scenario ...]
@@ -1283,6 +1291,216 @@ def scenario_lease_kill() -> int:
     return 0
 
 
+# ---------------------------------------------------------------------------
+# the swap_kill child: the stream CLI cannot swap, so the victim drives
+# CityRegistry directly — load v1 (stamping the store's epoch), commit
+# the pre-swap tile tree, then swap to v2 with the city.swap crash
+# failpoint armed. The failpoint sits in the WIDEST window (candidate
+# loaded + shadow-gated, nothing flipped), so the os._exit(137) lands
+# with the datastore lease still held and both versions resident.
+_SWAP_CHILD_SCRIPT = r"""
+import os, sys
+
+from reporter_tpu.datastore import ingest_dir
+from reporter_tpu.service.cities import CityRegistry
+
+store = os.environ["SWAP_CHILD_STORE"]
+g1 = os.environ["SWAP_CHILD_G1"]
+g2 = os.environ["SWAP_CHILD_G2"]
+out_a = os.environ["SWAP_CHILD_TILES"]
+
+reg = CityRegistry(
+    config={"metro": {"graph": g1, "datastore": store}},
+    budget_bytes=1 << 40)
+entry = reg.get("metro")
+assert entry.map_version, "v1 load did not mint a map version"
+got = ingest_dir(entry.service.datastore, out_a)
+assert got["rows"] and not got["failures"], got
+# armed city.swap=crash#1 fires inside: loaded+gated, v1 serving
+reg.swap("metro", {"graph": g2, "datastore": store})
+sys.exit(3)  # unreachable when the failpoint is armed
+"""
+
+
+def scenario_swap_kill() -> int:
+    """Zero-downtime map lifecycle under SIGKILL (ISSUE 20): a
+    registry-driven worker dies at the ``city.swap`` crash failpoint —
+    the widest swap window (candidate v2 loaded and shadow-gated, v1
+    still serving, datastore lease held). Recovery must steal the dead
+    holder's lease, replay the pre-swap tile tree under v1's epoch
+    (ledger-deduped — it committed before the crash) and the post-swap
+    tree under v2's, and end with store cells equal to a fault-free
+    run's, every base segment tagged exactly ONE epoch, and per-epoch
+    pinned views matching the reference — exactly-once ACROSS map
+    versions. A pre-swap dead-letter trace spool must then drain
+    through the NEW graph without crashing."""
+    from reporter_tpu.datastore import EpochView, LocalDatastore, ingest_dir
+    from reporter_tpu.graph.version import map_version
+    from reporter_tpu.utils import faults as faults_mod
+    from reporter_tpu.utils import metrics
+
+    def pinned_cells(store, mv):
+        # merged_cells only sweeps partitions()/live_segments(), the
+        # exact protocol EpochView serves — call it unbound on the view
+        return LocalDatastore.merged_cells(EpochView(store, mv))
+
+    with tempfile.TemporaryDirectory() as tmp:
+        city = _city()
+        # v2: same geometry and segment ids (shadow scores agree), new
+        # speed profile -> a genuinely different content hash
+        city2 = _city()
+        city2.edge_speed_kph = city2.edge_speed_kph * 1.1
+        g1 = os.path.join(tmp, "city-v1.npz")
+        g2 = os.path.join(tmp, "city-v2.npz")
+        city.save(g1)
+        city2.save(g2)
+        mv1, mv2 = map_version(city), map_version(city2)
+        if mv1 == mv2:
+            return fail("speed change did not mint a new map version")
+
+        # tile trees: A is pre-swap (v1) traffic, B is post-swap (v2)
+        lines_a = _lines(city, n_traces=6, seed=9)
+        lines_b = _lines(city2, n_traces=6, seed=31)
+        out_a = os.path.join(tmp, "tiles-v1")
+        out_b = os.path.join(tmp, "tiles-v2")
+        wa = _make_worker(city, out_a, report_flush_interval_s=0.0)
+        wa.run(iter(lines_a))
+        wb = _make_worker(city2, out_b, report_flush_interval_s=0.0)
+        wb.run(iter(lines_b))
+        if not _tile_tree(out_a) or not _tile_tree(out_b):
+            return fail("tile trees empty before the chaos leg")
+
+        # chaos leg: the victim ingests tree A under v1, then dies at
+        # the city.swap failpoint holding the lease
+        store_chaos = os.path.join(tmp, "store_chaos")
+        env = dict(os.environ, REPORTER_TPU_PLATFORM="cpu",
+                   REPORTER_TPU_FAULTS="city.swap=crash#1",
+                   REPORTER_TPU_STORE_LEASE_S="30",
+                   SWAP_CHILD_STORE=store_chaos, SWAP_CHILD_G1=g1,
+                   SWAP_CHILD_G2=g2, SWAP_CHILD_TILES=out_a)
+        p = subprocess.run([sys.executable, "-c", _SWAP_CHILD_SCRIPT],
+                           env=env, cwd=REPO, capture_output=True,
+                           text=True, timeout=600)
+        if p.returncode != faults_mod.CRASH_EXIT_CODE:
+            return fail(f"swap victim rc={p.returncode} "
+                        f"(want {faults_mod.CRASH_EXIT_CODE}): "
+                        f"{p.stderr[-2000:]}")
+
+        # no torn manifest despite the mid-swap SIGKILL
+        ds = LocalDatastore(store_chaos)
+        err = _assert_untorn(ds)
+        if err:
+            return fail(err)
+
+        # recovery is "another process": the dead holder never
+        # released, so our first mutation must STEAL its lease; the
+        # pre-swap tree re-ingests under v1's epoch and every flush
+        # the victim committed dedupes through the epoch-qualified
+        # ledger (key@mv1) — nothing double-counts across the crash
+        metrics.default.reset()
+        ds.set_map_version(mv1)
+        ingest_dir(ds, out_a)
+        snap = metrics.default.snapshot()["counters"]
+        if not snap.get("datastore.lease.steals"):
+            return fail(f"no lease steal counted after victim death: "
+                        f"{ {k: v for k, v in snap.items() if 'lease' in k} }")
+        if not snap.get("datastore.ingest.deduped"):
+            return fail("epoch-qualified ledger deduped nothing on the "
+                        "v1 recovery replay — exactly-once lost")
+        # the post-swap world: v2 traffic lands under the new epoch
+        ds.set_map_version(mv2)
+        got = ingest_dir(ds, out_b)
+        if not got["rows"] or got["failures"]:
+            return fail(f"v2 ingest after recovery failed: {got}")
+        ds.compact(max_deltas=0)
+
+        # epoch integrity: every post-compaction segment carries
+        # exactly one tag, both epochs exist, nothing mixes
+        tags_seen = set()
+        for level, index in ds.partitions():
+            manifest = ds._read_manifest(ds.partition_dir(level, index))
+            tags = manifest.get("epochs", {})
+            for name in manifest["segments"]:
+                tag = tags.get(name)
+                if tag not in (mv1, mv2):
+                    return fail(f"segment {level}/{index}/{name} has "
+                                f"epoch tag {tag!r} (want {mv1} or "
+                                f"{mv2}) — mixed/missing epoch")
+                tags_seen.add(tag)
+        if tags_seen != {mv1, mv2}:
+            return fail(f"expected both epochs in the recovered store, "
+                        f"got {sorted(tags_seen)}")
+
+        # parity vs a fault-free run of the same two epochs: merged
+        # cells AND each pinned view must match cell for cell — the
+        # crash neither lost nor duplicated either version's traffic
+        ref = LocalDatastore(os.path.join(tmp, "store_fresh"))
+        ref.set_map_version(mv1)
+        ingest_dir(ref, out_a)
+        ref.set_map_version(mv2)
+        ingest_dir(ref, out_b)
+        ref.compact(max_deltas=0)
+        if _store_cells(ds) != _store_cells(ref):
+            return fail("recovered store cells differ from a fresh "
+                        "fault-free two-epoch ingest")
+        for mv in (mv1, mv2):
+            if pinned_cells(ds, mv) != pinned_cells(ref, mv):
+                return fail(f"pinned view {mv} differs from the "
+                            "fault-free reference — epochs mixed "
+                            "across the crash")
+        # a second replay of BOTH trees appends nothing (either epoch)
+        for mv, out_dir in ((mv1, out_a), (mv2, out_b)):
+            ds.set_map_version(mv)
+            got = ingest_dir(ds, out_dir)
+            if got["rows"]:
+                return fail(f"re-ingest under {mv} appended "
+                            f"{got['rows']} rows — ledger failed "
+                            "after the crash")
+
+        # drainer leg: trace JSON spooled on v1 (dead matcher) must
+        # replay through the NEW graph's pipeline without crashing
+        os.environ["REPORTER_TPU_REPLAY_INTERVAL_S"] = "1000000"
+        os.environ["REPORTER_TPU_REPLAY_ATTEMPTS"] = "10"
+        try:
+            metrics.default.reset()
+            out_sw = os.path.join(tmp, "swapspool")
+            w1 = _make_worker(city, out_sw, report_flush_interval_s=0.0)
+            faults_mod.configure("matcher.submit=error@0")
+            try:
+                w1.run(iter(lines_a))
+            finally:
+                faults_mod.clear()
+            snap = metrics.default.snapshot()["counters"]
+            if not snap.get("batch.deadletter"):
+                return fail(f"dead matcher spooled no pre-swap traces: "
+                            f"{snap}")
+            w2 = _make_worker(city2, out_sw, report_flush_interval_s=0.0)
+            if w2.drainer is None:
+                return fail("post-swap drainer did not arm")
+            backlog = w2.drainer.backlog()
+            if not backlog["traces"]:
+                return fail(f"pre-swap spool empty before the post-swap "
+                            f"drain: {backlog}")
+            w2.drain()
+            snap = metrics.default.snapshot()["counters"]
+            if not snap.get("replay.traces.ok"):
+                return fail(f"post-swap drainer replayed no pre-swap "
+                            f"traces: {snap}")
+            backlog = w2.drainer.backlog()
+            if backlog["traces"]:
+                return fail(f"pre-swap spool not drained on the new "
+                            f"graph: {backlog}")
+        finally:
+            os.environ.pop("REPORTER_TPU_REPLAY_INTERVAL_S", None)
+            os.environ.pop("REPORTER_TPU_REPLAY_ATTEMPTS", None)
+
+    log(f"swap_kill ok: mid-swap SIGKILL (epochs {mv1} -> {mv2}) left "
+        "no torn manifest, the lease steal was clean, both epochs "
+        "recovered to fault-free parity with single-tagged segments, "
+        "and the pre-swap spool drained through the new graph")
+    return 0
+
+
 def scenario_overload_recovery() -> int:
     """Load management end-to-end (ISSUE 15): drive the service past
     capacity with admission armed -> the gate sheds (counted, every
@@ -1523,6 +1741,7 @@ SCENARIOS = {
     "double_ingest": scenario_double_ingest,
     "replay_drain": scenario_replay_drain,
     "lease_kill": scenario_lease_kill,
+    "swap_kill": scenario_swap_kill,
     "overload_recovery": scenario_overload_recovery,
 }
 

@@ -23,12 +23,23 @@ all live), then served from ONE fleet — a single HTTP service whose
   /profile. Needs the native runtime; set
   REPORTER_TPU_CHAOS_REQUIRE_NATIVE=1 (CI does) to fail rather than
   skip when it is missing.
+- **zero-downtime map swap (ISSUE 20)**: 1000 threaded requests
+  straddle a live ``registry.swap`` to a new map build — ZERO may
+  fail; /health flips its resident ``map_version`` and counts the
+  flip in the swap block. A divergent candidate graph is then
+  REFUSED by the dual-version shadow gate (agreement below the
+  floor), counted and surfaced, with the serving version unchanged.
+
+``--swap-only`` runs just the produce legs + the swap leg (the CI
+``swap_smoke`` stage pairs it with ``chaos.py swap_kill``).
 """
 import json
 import os
 import socket
 import sys
 import tempfile
+import threading
+import time
 import urllib.request
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -39,6 +50,9 @@ os.environ.setdefault("REPORTER_TPU_PLATFORM", "cpu")  # CI: never probe
 # repeat within a process, so SHARED-memo hit counters are a pure
 # signal of the pre-warm (see the cold-start assertion below)
 os.environ.setdefault("REPORTER_TPU_PREP_THREADS", "1")
+# capture every admitted request for the swap shadow gate: the flip
+# leg's agreement assertion must not depend on sampling luck
+os.environ.setdefault("REPORTER_TPU_SWAP_SAMPLE", "1")
 
 FMT = ",sv,\\|,0,1,2,3,4"
 
@@ -148,6 +162,7 @@ def main() -> int:
         os.environ.get("REPORTER_TPU_CHAOS_REQUIRE_NATIVE"))
     if not native.available() and require_native:
         return fail("native runtime unavailable but required")
+    swap_only = "--swap-only" in sys.argv[1:]
 
     with tempfile.TemporaryDirectory() as tmp:
         graphs, stores, reqs = {}, {}, {}
@@ -177,47 +192,163 @@ def main() -> int:
             port = s.getsockname()[1]
         httpd = serve(service, "127.0.0.1", port)
         try:
-            # ---- lease + compactor on /health ------------------------
-            health = _get(port, "/health")
-            lease = health["datastore"].get("lease") or {}
-            if not lease.get("enabled"):
-                return fail(f"/health carries no live lease view: "
-                            f"{health['datastore']}")
-            if health.get("compaction") != real_backlog:
-                return fail(f"/health compaction gauge "
-                            f"{health.get('compaction')} != the "
-                            f"refreshed sweep {real_backlog}")
-            if real_backlog["partitions_over"]:
-                return fail(f"worker-leg compactor left pressure: "
-                            f"{real_backlog}")
+            if not swap_only:
+                # ---- lease + compactor on /health --------------------
+                health = _get(port, "/health")
+                lease = health["datastore"].get("lease") or {}
+                if not lease.get("enabled"):
+                    return fail(f"/health carries no live lease view: "
+                                f"{health['datastore']}")
+                if health.get("compaction") != real_backlog:
+                    return fail(f"/health compaction gauge "
+                                f"{health.get('compaction')} != the "
+                                f"refreshed sweep {real_backlog}")
+                if real_backlog["partitions_over"]:
+                    return fail(f"worker-leg compactor left pressure: "
+                                f"{real_backlog}")
 
-            # ---- batched queries vs single answers -------------------
-            bbox_body = _get(
-                port, "/histogram?city=metro-a&bbox=-180,-90,180,90"
-                      "&level=2")
-            segs = bbox_body["segments"]
-            if len(segs) < 5 or bbox_body["truncated"]:
-                return fail(f"bbox query implausible: n="
-                            f"{bbox_body['n_segments']} "
-                            f"truncated={bbox_body['truncated']}")
-            ids = [s["segment_id"] for s in segs]
-            for s in segs:
-                single = _get(port, f"/histogram?city=metro-a"
-                                    f"&segment_id={s['segment_id']}")
-                if single != s:
-                    return fail(f"bbox answer differs from single for "
-                                f"{s['segment_id']}")
-            many = _get(port, "/histogram?city=metro-a&"
-                        + "&".join(f"segment={i}" for i in ids[:8]))
-            for got, want_id in zip(many["results"], ids[:8]):
-                single = _get(port, f"/histogram?city=metro-a"
-                                    f"&segment_id={want_id}")
-                if got != single:
-                    return fail(f"query_many answer differs from single "
-                                f"for {want_id}")
-            log(f"batched parity: {len(segs)} bbox segments + "
-                f"{len(ids[:8])} repeated-param segments all equal "
-                f"their single answers")
+                # ---- batched queries vs single answers ---------------
+                bbox_body = _get(
+                    port, "/histogram?city=metro-a&bbox=-180,-90,180,90"
+                          "&level=2")
+                segs = bbox_body["segments"]
+                if len(segs) < 5 or bbox_body["truncated"]:
+                    return fail(f"bbox query implausible: n="
+                                f"{bbox_body['n_segments']} "
+                                f"truncated={bbox_body['truncated']}")
+                ids = [s["segment_id"] for s in segs]
+                for s in segs:
+                    single = _get(port, f"/histogram?city=metro-a"
+                                        f"&segment_id={s['segment_id']}")
+                    if single != s:
+                        return fail(f"bbox answer differs from single "
+                                    f"for {s['segment_id']}")
+                many = _get(port, "/histogram?city=metro-a&"
+                            + "&".join(f"segment={i}" for i in ids[:8]))
+                for got, want_id in zip(many["results"], ids[:8]):
+                    single = _get(port, f"/histogram?city=metro-a"
+                                        f"&segment_id={want_id}")
+                    if got != single:
+                        return fail(f"query_many answer differs from "
+                                    f"single for {want_id}")
+                log(f"batched parity: {len(segs)} bbox segments + "
+                    f"{len(ids[:8])} repeated-param segments all equal "
+                    f"their single answers")
+
+            # ---- zero-downtime map swap (ISSUE 20) -------------------
+            # v2 = same geometry with uniformly scaled speeds: same
+            # segment ids (shadow scores agree — uniform scaling
+            # preserves every argmin route), different content hash
+            from reporter_tpu.graph.version import map_version
+            net_v1 = RoadNetwork.load(graphs["metro-a"])
+            mv1 = map_version(net_v1)
+            net_v2 = RoadNetwork.load(graphs["metro-a"])
+            net_v2.edge_speed_kph = net_v2.edge_speed_kph * 1.1
+            g2 = os.path.join(tmp, "metro-a-v2.npz")
+            net_v2.save(g2)
+            mv2 = map_version(net_v2)
+            if mv1 == mv2:
+                return fail("speed change minted no new map version")
+            # a few warm-up reports make metro-a resident and seed the
+            # shadow capture ring before the burst
+            for r in reqs["metro-a"][:4]:
+                _post(port, "/report", dict(r, city="metro-a"))
+            h0 = _get(port, "/health")
+            res0 = (h0["cities"]["resident"].get("metro-a") or {})
+            if res0.get("map_version") != mv1:
+                return fail(f"/health resident map_version "
+                            f"{res0.get('map_version')} != {mv1}")
+
+            failures = []
+
+            def hammer(k):
+                rs = reqs["metro-a"]
+                for i in range(125):
+                    r = rs[(k * 131 + i) % len(rs)]
+                    try:
+                        _post(port, "/report", dict(r, city="metro-a"))
+                    except Exception as e:
+                        failures.append(f"{type(e).__name__}: {e}")
+
+            threads = [threading.Thread(target=hammer, args=(k,))
+                       for k in range(8)]
+            for t in threads:
+                t.start()
+            time.sleep(0.25)  # let the burst straddle the flip
+            record = registry.swap(
+                "metro-a",
+                {"graph": g2, "datastore": stores["metro-a"]})
+            for t in threads:
+                t.join()
+            if failures:
+                return fail(f"{len(failures)} of 1000 in-flight "
+                            f"requests failed across the flip: "
+                            f"{failures[:3]}")
+            if record["result"] != "flipped":
+                return fail(f"swap did not flip: {record}")
+            h1 = _get(port, "/health")
+            res1 = (h1["cities"]["resident"].get("metro-a") or {})
+            if res1.get("map_version") != mv2:
+                return fail(f"/health still shows "
+                            f"{res1.get('map_version')} after the "
+                            f"flip to {mv2}")
+            swap_blk = h1["cities"].get("swap") or {}
+            if not swap_blk.get("flips"):
+                return fail(f"/health swap block counts no flips: "
+                            f"{swap_blk}")
+            last = (swap_blk.get("last") or {}).get("metro-a") or {}
+            if last.get("result") != "flipped" \
+                    or last.get("to") != mv2:
+                return fail(f"/health swap.last wrong: {last}")
+            log(f"swap flip: 1000 in-flight requests, 0 failures, "
+                f"{mv1} -> {mv2} (agreement "
+                f"{record.get('agreement')} over "
+                f"{record.get('checks')} shadow checks)")
+
+            # refusal: a DIVERGENT graph (different grid) must be
+            # refused by the shadow gate — counted, surfaced, and the
+            # serving version unchanged
+            for r in reqs["metro-a"][:6]:
+                _post(port, "/report", dict(r, city="metro-a"))
+            from reporter_tpu.synth import build_grid_city
+            alien = build_grid_city(rows=6, cols=6, spacing_m=150.0,
+                                    seed=2, service_road_fraction=0.0,
+                                    internal_fraction=0.0)
+            g3 = os.path.join(tmp, "metro-a-alien.npz")
+            alien.save(g3)
+            record = registry.swap(
+                "metro-a",
+                {"graph": g3, "datastore": stores["metro-a"]})
+            if record["result"] != "refused_shadow":
+                return fail(f"divergent graph was not refused: "
+                            f"{record}")
+            if not record["checks"] \
+                    or record["agreement"] >= record["floor"]:
+                return fail(f"refusal record implausible: {record}")
+            h2 = _get(port, "/health")
+            res2 = (h2["cities"]["resident"].get("metro-a") or {})
+            swap_blk = h2["cities"].get("swap") or {}
+            if res2.get("map_version") != mv2:
+                return fail(f"refused swap changed the serving "
+                            f"version: {res2.get('map_version')}")
+            if not swap_blk.get("refusals"):
+                return fail(f"/health swap block counts no refusals: "
+                            f"{swap_blk}")
+            if (swap_blk.get("last") or {}).get("metro-a", {}) \
+                    .get("result") != "refused_shadow":
+                return fail(f"/health swap.last missed the refusal: "
+                            f"{swap_blk}")
+            # still serving v2 after the refusal
+            _post(port, "/report",
+                  dict(reqs["metro-a"][0], city="metro-a"))
+            log(f"swap refusal: divergent graph refused at agreement "
+                f"{record['agreement']} (floor {record['floor']}), "
+                f"serving version unchanged")
+            if swap_only:
+                print("serve smoke ok (swap legs only): flip with 0 "
+                      "failed in-flight requests; divergent graph "
+                      "refused, counted, surfaced")
+                return 0
 
             # ---- city LRU + memo pre-warm ----------------------------
             if not native.available():
